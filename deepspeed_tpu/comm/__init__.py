@@ -1,0 +1,25 @@
+"""deepspeed_tpu.comm — XLA/ICI communication backend.
+
+See reference ``deepspeed/comm/__init__.py`` (re-exports the comm facade).
+"""
+
+from deepspeed_tpu.comm.comm import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all_single,
+    axis_index,
+    barrier,
+    broadcast,
+    get_local_device_count,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    is_initialized,
+    log_summary,
+    ppermute,
+    reduce_scatter,
+    send_recv_next,
+    send_recv_prev,
+)
+from deepspeed_tpu.comm.logging import CommsLogger, comms_logger  # noqa: F401

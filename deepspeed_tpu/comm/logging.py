@@ -1,0 +1,87 @@
+"""Communication op logging.
+
+Parity with reference ``deepspeed/utils/comms_logging.py:56`` (CommsLogger:
+per-op counts, message sizes, summary table). Difference, by design: inside a
+jitted SPMD program ops cannot be timed individually (XLA schedules them), so
+trace-time logging records op/shape/bytes, and real latency comes from the
+standalone comm benchmarks (benchmarks/communication in the reference;
+``deepspeed_tpu/benchmarks/comm_bench.py`` here).
+"""
+
+import threading
+from collections import defaultdict
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _nbytes(x) -> int:
+    try:
+        return int(np.prod(x.shape)) * x.dtype.itemsize
+    except Exception:
+        return 0
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, prof_ops=None, debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        self._lock = threading.Lock()
+        # op name -> {"count": int, "bytes": int, "msg_sizes": {size: count}}
+        self.comms_dict: Dict[str, Dict] = defaultdict(
+            lambda: {"count": 0, "bytes": 0, "msg_sizes": defaultdict(int)}
+        )
+
+    def configure(self, config) -> None:
+        self.enabled = config.enabled
+        self.verbose = config.verbose
+        self.prof_all = config.prof_all
+        self.prof_ops = list(config.prof_ops)
+        self.debug = config.debug
+
+    def _should_log(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, op_name: str, tensor, axis: Optional[str], log_name: Optional[str] = None) -> None:
+        """Record one collective at trace time."""
+        name = log_name or op_name
+        if not self._should_log(name):
+            return
+        size = _nbytes(tensor)
+        with self._lock:
+            rec = self.comms_dict[name]
+            rec["count"] += 1
+            rec["bytes"] += size
+            rec["msg_sizes"][size] += 1
+        if self.verbose:
+            log_dist(
+                f"comm op: {name} | axis: {axis} | msg size: {size} bytes",
+                ranks=[0],
+            )
+
+    def log_summary(self) -> str:
+        lines = ["Comm. Op            Count    Total Bytes"]
+        with self._lock:
+            for name, rec in sorted(self.comms_dict.items()):
+                lines.append(f"{name:<20}{rec['count']:<9}{rec['bytes']}")
+                for size, cnt in sorted(rec["msg_sizes"].items()):
+                    lines.append(f"    msg size {size:>12} B  x{cnt}")
+        summary = "\n".join(lines)
+        log_dist(summary, ranks=[0])
+        return summary
+
+    def reset(self) -> None:
+        with self._lock:
+            self.comms_dict.clear()
+
+
+# process-global instance, configured by the engine from the comms_logger block
+comms_logger = CommsLogger()

@@ -1,0 +1,66 @@
+"""Config helpers.
+
+Parity with reference ``deepspeed/runtime/config_utils.py`` (get_scalar_param,
+pydantic-style DeepSpeedConfigModel at :161) using plain dataclasses — no
+pydantic dependency; unknown keys warn instead of failing, matching the
+reference's permissive "extra field" behavior.
+"""
+
+import dataclasses
+import json
+from typing import Any, Dict
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys in the user JSON (reference config_utils.py)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+class ConfigModel:
+    """Minimal stand-in for the reference's pydantic DeepSpeedConfigModel:
+    dataclass subclasses get ``from_dict`` with unknown-key warnings and
+    deprecated-alias support via ``_aliases = {old: new}``."""
+
+    _aliases: Dict[str, str] = {}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]):
+        if d is None:
+            d = {}
+        if not isinstance(d, dict):
+            raise TypeError(f"{cls.__name__} config block must be a dict, got {type(d)}")
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for key, value in d.items():
+            key = cls._aliases.get(key, key)
+            if key in field_names:
+                kwargs[key] = value
+            else:
+                logger.warning("%s: ignoring unknown config key %r", cls.__name__, key)
+        inst = cls(**kwargs)
+        if hasattr(inst, "__post_init__validate__"):
+            inst.__post_init__validate__()
+        return inst
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self.to_dict()})"
+
+
+def pretty_json(d: Dict) -> str:
+    return json.dumps(d, indent=2, sort_keys=True, default=str)

@@ -1,0 +1,63 @@
+"""Rank-aware logging utilities.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (log_dist,
+rank-filtered logger) re-expressed for JAX: "rank" is ``jax.process_index()``.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL = os.environ.get("DEEPSPEED_TPU_LOG_LEVEL", "INFO").upper()
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str, level: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    logger.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    return logger
+
+
+logger = _create_logger("deepspeed_tpu", LOG_LEVEL)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax not initialised yet
+        return 0
+
+
+def should_log_on_rank(ranks=None) -> bool:
+    """True when the current process should emit a log line.
+
+    Mirrors reference ``deepspeed/utils/logging.py`` log_dist rank filtering:
+    ``ranks=None`` or ``[-1]`` means all ranks; otherwise only listed ranks log.
+    """
+    if ranks is None:
+        ranks = [0]
+    my_rank = _process_index()
+    return -1 in ranks or my_rank in ranks
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    if should_log_on_rank(ranks):
+        logger.log(level, "[Rank %s] %s", _process_index(), message)
+
+
+def warning_once(message: str) -> None:
+    _warn_once_cached(message)
+
+
+@functools.lru_cache(None)
+def _warn_once_cached(message: str) -> None:
+    logger.warning(message)

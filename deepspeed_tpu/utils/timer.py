@@ -1,0 +1,185 @@
+"""Wall-clock and throughput timers.
+
+Parity with reference ``deepspeed/utils/timer.py`` (SynchronizedWallClockTimer
+:20-133, ThroughputTimer :135). CUDA-event synchronisation is replaced by
+``jax.block_until_ready`` on live arrays (the honest TPU analogue: XLA is
+async-dispatched exactly like CUDA streams).
+"""
+
+import time
+from collections import OrderedDict
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _sync():
+    try:
+        import jax
+
+        # Fence the async dispatch queue: a tiny op ordered after everything
+        # already enqueued on the default device.
+        jax.block_until_ready(jax.device_put(0.0))
+    except Exception:  # pragma: no cover
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name_ = name
+        self.started_ = False
+        self.elapsed_ = 0.0
+        self.start_time = 0.0
+        self.count = 0
+
+    def start(self, sync: bool = True):
+        assert not self.started_, f"timer {self.name_} has already been started"
+        if sync:
+            _sync()
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, reset: bool = False, sync: bool = True):
+        assert self.started_, f"timer {self.name_} is not started"
+        if sync:
+            _sync()
+        elapsed = time.time() - self.start_time
+        if reset:
+            self.elapsed_ = elapsed
+        else:
+            self.elapsed_ += elapsed
+        self.started_ = False
+        self.count += 1
+
+    def reset(self):
+        self.started_ = False
+        self.elapsed_ = 0.0
+        self.count = 0
+
+    def elapsed(self, reset: bool = True):
+        started = self.started_
+        if started:
+            self.stop()
+        elapsed = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return elapsed
+
+    def mean(self):
+        return (self.elapsed_ / self.count) if self.count else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer group; `log()` prints a one-line breakdown like the
+    reference's wall_clock_breakdown output (engine.py:2063-2078)."""
+
+    def __init__(self):
+        self.timers = OrderedDict()
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names=None, normalizer: float = 1.0, reset: bool = True, ranks=None):
+        assert normalizer > 0.0
+        names = names if names is not None else list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names, normalizer: float = 1.0):
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names
+            if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """samples/sec + optional TFLOPS reporting (reference utils/timer.py:135)."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        start_step: int = 2,
+        steps_per_output: int = 50,
+        monitor_memory: bool = False,
+        logging_fn=None,
+    ):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            self.start_time = 0.0
+            if global_step and report_speed and (
+                self.global_step_count % self.steps_per_output == 0
+            ):
+                self.logging(
+                    "epoch={}/micro_step={}/global_step={}, "
+                    "RunningAvgSamplesPerSec={:.3f}, CurrSamplesPerSec={:.3f}".format(
+                        self.epoch_count,
+                        self.micro_step_count,
+                        self.global_step_count,
+                        self.avg_samples_per_sec(),
+                        self.batch_size / self.step_elapsed_time
+                        if self.step_elapsed_time
+                        else 0.0,
+                    )
+                )
+        if global_step:
+            self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            if self.total_elapsed_time > 0:
+                return samples / self.total_elapsed_time
+        return 0.0

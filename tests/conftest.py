@@ -1,0 +1,47 @@
+"""Test harness configuration.
+
+The reference spawns real multi-GPU processes per distributed test
+(tests/unit/common.py:68 DistributedTest). The TPU-native equivalent is a
+CPU-simulated multi-device mesh: 8 virtual XLA devices in ONE process, which
+exercises the same SPMD programs (collectives included) deterministically.
+These env vars must be set before the first ``import jax`` anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# A site plugin may have pinned jax_platforms to an accelerator at interpreter
+# startup; unit tests always run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """Each test gets a fresh default mesh topology."""
+    yield
+    from deepspeed_tpu.parallel import mesh
+
+    mesh.reset_default_topology()
+
+
+@pytest.fixture
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

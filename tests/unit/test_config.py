@@ -1,0 +1,142 @@
+"""Config system tests (parity with reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_basic_dict_config():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 16, "fp16": {"enabled": False}}, dp_world_size=4
+    )
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+    assert cfg.precision_dtype == "float32"
+
+
+def test_batch_triad_micro_and_gas():
+    cfg = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3},
+        dp_world_size=4,
+    )
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_triad_train_and_micro():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4},
+        dp_world_size=2,
+    )
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triad_inconsistent_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {
+                "train_batch_size": 10,
+                "train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 2,
+            },
+            dp_world_size=2,
+        )
+
+
+def test_batch_triad_missing_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"fp16": {"enabled": True}}, dp_world_size=2)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {
+                "train_batch_size": 8,
+                "fp16": {"enabled": True},
+                "bf16": {"enabled": True},
+            },
+            dp_world_size=1,
+        )
+
+
+def test_zero_config_stage3_aliases():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_prefetch_bucket_size": 12345,
+                "stage3_param_persistence_threshold": 42,
+                "offload_optimizer": {"device": "cpu"},
+            },
+        },
+        dp_world_size=2,
+    )
+    z = cfg.zero_config
+    assert z.stage == 3
+    assert z.prefetch_bucket_size == 12345
+    assert z.param_persistence_threshold == 42
+    assert z.offload_optimizer_config.device == "cpu"
+    assert cfg.zero_enabled
+
+
+def test_invalid_zero_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {"train_batch_size": 8, "zero_optimization": {"stage": 5}},
+            dp_world_size=1,
+        )
+
+
+def test_json_file_config(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(
+        json.dumps(
+            {
+                "train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+                "bf16": {"enabled": True},
+                "gradient_clipping": 1.0,
+            }
+        )
+    )
+    cfg = DeepSpeedConfig(str(p), dp_world_size=8)
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.optimizer.params["lr"] == 1e-3
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.precision_dtype == "bfloat16"
+    assert cfg.gradient_clipping == 1.0
+    assert cfg.train_micro_batch_size_per_gpu == 1
+
+
+def test_duplicate_key_raises(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), dp_world_size=1)
+
+
+def test_tpu_mesh_block():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "tpu": {"mesh": {"dp": 2, "tp": 2}, "remat": "full"}},
+        dp_world_size=2,
+    )
+    assert cfg.tpu.mesh_config.dp == 2
+    assert cfg.tpu.mesh_config.tp == 2
+    assert cfg.tpu.remat == "full"
+
+
+def test_unknown_keys_tolerated():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "fp16": {"enabled": True, "some_future_knob": 1},
+            "communication_data_type": "fp32",
+        },
+        dp_world_size=1,
+    )
+    assert cfg.fp16.enabled

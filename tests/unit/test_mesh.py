@@ -1,0 +1,78 @@
+"""MeshTopology tests (parity with reference tests/unit/ pipe topology tests)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.mesh import (
+    MeshTopology,
+    shard_largest_dim_spec,
+    topology_from_config,
+)
+from jax.sharding import PartitionSpec
+
+
+def test_default_all_dp(eight_devices):
+    topo = MeshTopology()
+    assert topo.size("dp") == 8
+    assert topo.data_parallel_size == 8
+    assert topo.num_devices == 8
+
+
+def test_mixed_axes(eight_devices):
+    topo = MeshTopology(dp=2, tp=2, pp=2)
+    assert topo.size("dp") == 2
+    assert topo.model_parallel_size == 2
+    assert topo.pipe_parallel_size == 2
+    assert topo.data_parallel_size == 2
+    assert set(topo.active_axes()) == {"dp", "tp", "pp"}
+
+
+def test_infer_axis(eight_devices):
+    topo = MeshTopology(dp=-1, tp=4)
+    assert topo.size("dp") == 2
+
+
+def test_bad_sizes(eight_devices):
+    with pytest.raises(ValueError):
+        MeshTopology(dp=3, tp=2)
+    with pytest.raises(ValueError):
+        MeshTopology(dp=-1, tp=-1)
+
+
+def test_coord_roundtrip(eight_devices):
+    topo = MeshTopology(dp=2, fsdp=2, tp=2)
+    seen = set()
+    for r in range(8):
+        c = topo.coord_of(r)
+        seen.add((c["dp"], c["fsdp"], c["tp"]))
+    assert len(seen) == 8
+
+
+def test_filter_ranks(eight_devices):
+    topo = MeshTopology(dp=2, tp=4)
+    ranks = topo.filter_ranks(dp=0)
+    assert len(ranks) == 4
+
+
+def test_batch_spec(eight_devices):
+    topo = MeshTopology(dp=2, fsdp=2, tp=2)
+    assert topo.batch_spec() == PartitionSpec(("dp", "fsdp"))
+    topo2 = MeshTopology(tp=8)
+    assert topo2.batch_spec() == PartitionSpec(None)
+
+
+def test_topology_from_config(eight_devices):
+    topo = topology_from_config({"dp": 4, "fsdp": 2})
+    assert topo.size("fsdp") == 2
+    assert topo.data_parallel_size == 8
+
+
+def test_shard_largest_dim_spec():
+    assert shard_largest_dim_spec((128, 64), "fsdp", 8) == PartitionSpec("fsdp", None)
+    assert shard_largest_dim_spec((64, 128), "fsdp", 8) == PartitionSpec(None, "fsdp")
+    # indivisible dims -> replicated
+    assert shard_largest_dim_spec((7, 13), "fsdp", 8) == PartitionSpec()
+    # below min size -> replicated (persistence threshold analogue)
+    assert shard_largest_dim_spec((8,), "fsdp", 8, min_size=100) == PartitionSpec()
+    # axis size 1 -> replicated
+    assert shard_largest_dim_spec((128, 64), "fsdp", 1) == PartitionSpec()
