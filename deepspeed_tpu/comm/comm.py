@@ -63,13 +63,10 @@ def all_reduce(x, axis: str, op: str = ReduceOp.SUM):
     if op == ReduceOp.MIN:
         return lax.pmin(x, axis)
     if op == ReduceOp.PROD:
-        # Signed product: combine magnitude (log-sum-exp of |x|), sign parity,
-        # and a zero mask — log alone NaNs on negatives.
-        magnitude = jnp.exp(lax.psum(jnp.log(jnp.where(x == 0, 1.0, jnp.abs(x))), axis))
-        neg_count = lax.psum((x < 0).astype(x.dtype), axis)
-        sign = jnp.where(neg_count % 2 == 0, 1.0, -1.0).astype(x.dtype)
-        any_zero = lax.pmax((x == 0).astype(x.dtype), axis)
-        return jnp.where(any_zero > 0, jnp.zeros_like(x), sign * magnitude)
+        # Exact, dtype-preserving product: gather then reduce (no log/exp trick,
+        # which is inexact and NaNs on negatives).
+        gathered = lax.all_gather(x, axis)
+        return jnp.prod(gathered, axis=0)
     raise ValueError(f"unsupported reduce op {op}")
 
 
@@ -159,18 +156,21 @@ def init_distributed(
     multi_host = coordinator_address is not None or (
         num_processes is not None and num_processes > 1
     )
-    if multi_host:
-        log_dist(
-            f"Initializing distributed JAX: coordinator={coordinator_address} "
-            f"procs={num_processes} id={process_id}",
-            ranks=[-1],
-        )
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            **kwargs,
-        )
+    if not multi_host:
+        # single-host no-op; do NOT latch _initialized so a later call with
+        # real coordinator args still performs the rendezvous
+        return
+    log_dist(
+        f"Initializing distributed JAX: coordinator={coordinator_address} "
+        f"procs={num_processes} id={process_id}",
+        ranks=[-1],
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
     _initialized = True
 
 

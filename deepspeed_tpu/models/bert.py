@@ -1,0 +1,172 @@
+"""TPU-first BERT encoder for the pretraining benchmark path.
+
+Capability counterpart of the reference's BERT story (BASELINE config 1;
+reference docs/_tutorials/bert-pretraining.md, tests/unit/modeling.py HF copy).
+Idiomatic JAX encoder: bf16 compute, einsum attention, scan-over-layers,
+MLM head tied to the token embedding.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+BERT_SIZES = {
+    "bert-base": dict(hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072),
+    "bert-large": dict(hidden_size=1024, num_hidden_layers=24,
+                       num_attention_heads=16, intermediate_size=4096),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    base = dict(BERT_SIZES[name])
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        if mask is not None:
+            att = jnp.where(mask[:, None, None, :], att, jnp.finfo(att.dtype).min)
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="output")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.config
+        # Post-LN like original BERT
+        a = BertSelfAttention(cfg, name="attention")(x, mask, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x + a)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="intermediate")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="output")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_out")(x + h)
+        return x
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.config
+        if cfg.scan_layers:
+            layer_cls = BertLayer
+            if cfg.remat:
+                layer_cls = nn.remat(BertLayer, prevent_cse=False)
+
+            def body(layer, carry):
+                x, mask = carry
+                return (layer(x, mask, deterministic), mask), None
+
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            (x, _), _ = scanned(layer_cls(cfg, name="layer"), (x, mask))
+            return x
+        for i in range(cfg.num_hidden_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, mask, deterministic)
+        return x
+
+
+class BertForPreTraining(nn.Module):
+    """BERT with MLM head (tied embeddings). ``__call__`` returns masked-LM
+    loss when ``labels`` given (-100 = ignore), else logits."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 labels=None, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="word_embeddings")
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="position_embeddings")
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="token_type_embeddings")
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = tok(input_ids) + pos(jnp.arange(T)[None, :]) + typ(token_type_ids)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="embeddings_ln")(x)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        x = BertEncoder(cfg, name="encoder")(x, attention_mask, deterministic)
+
+        # MLM transform + tied decoder
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="mlm_dense")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")(h)
+        logits = tok.attend(h.astype(jnp.float32))
+
+        if labels is None:
+            return logits
+        return masked_lm_loss(logits, labels)
+
+
+def masked_lm_loss(logits, labels):
+    """Mean CE over positions where labels != -100."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != -100
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    m = valid.astype(jnp.float32)
+    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -1,0 +1,224 @@
+"""TPU-first decoder-only transformer LM (GPT-2 family).
+
+This is the in-repo model zoo counterpart of the reference's transformer stack
+(reference ``csrc/transformer/`` fused training kernel +
+``deepspeed/ops/transformer/transformer.py:459`` DeepSpeedTransformerLayer).
+Design is idiomatic JAX, not a translation:
+
+* bf16 compute / fp32 params (mixed precision by dtype policy, not patching)
+* einsum attention — XLA fuses bias/gelu/residual into the MXU matmuls,
+  which is what the reference's hand-fused CUDA kernels exist to do
+* optional ``lax.scan`` over layers: O(1) compile time and natural remat
+* static shapes only; causal mask via iota comparison (no dynamic slicing)
+* weights carry stable path names so parallelism rules (TP/FSDP specs,
+  see deepspeed_tpu/runtime/zero/sharding.py) can address them by regex
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    scan_layers: bool = True
+    use_flash_attention: bool = False  # Pallas kernel path (ops/pallas)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+# GPT-2 sizes (reference benchmarks target 125M / 1.3B; BASELINE.md configs 2-5)
+GPT2_SIZES = {
+    "gpt2-125m": dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-350m": dict(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-760m": dict(n_embd=1536, n_layer=24, n_head=16),
+    "gpt2-1.3b": dict(n_embd=2048, n_layer=24, n_head=16),
+    "gpt2-2.7b": dict(n_embd=2560, n_layer=32, n_head=32),
+    "gpt2-6.7b": dict(n_embd=4096, n_layer=32, n_head=32),
+}
+
+
+def gpt2_config(name: str, **overrides) -> GPTConfig:
+    base = dict(GPT2_SIZES[name])
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask=None, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        H, D = cfg.n_head, cfg.head_dim
+
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+
+        if cfg.use_flash_attention and x.shape[1] % 128 == 0:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            scale = 1.0 / np.sqrt(D)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(causal[None, None, :, :], att, jnp.finfo(att.dtype).min)
+            if mask is not None:
+                att = jnp.where(mask[:, None, None, :], att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        y = y.reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="c_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic=True):
+        cfg = self.config
+        h = nn.Dense(cfg.mlp_ratio * cfg.n_embd, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="c_proj")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask=None, deterministic=True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x),
+            mask=mask, deterministic=deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x),
+            deterministic=deterministic)
+        return x
+
+
+class ScannedBlocks(nn.Module):
+    """All transformer blocks as one scanned module: params get a leading
+    ``n_layer`` axis, compile time is layer-count independent, and remat
+    applies per scan step (the activation-checkpointing sweet spot on TPU)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask=None, deterministic=True):
+        cfg = self.config
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block, prevent_cse=False,
+                static_argnums=(),
+            )
+
+        def body(block, carry):
+            x, mask = carry
+            x = block(x, mask=mask, deterministic=deterministic)
+            return (x, mask), None
+
+        scanned = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.n_layer,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        (x, _), _ = scanned(block_cls(cfg, name="block"), (x, mask))
+        return x
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. ``__call__(batch)`` returns mean cross-entropy loss
+    when ``batch["labels"]`` is present, else logits — the model contract the
+    engine trains against (see runtime/engine.py)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, attention_mask=None,
+                 deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wpe")
+        pos = jnp.arange(T)[None, :]
+        x = wte(input_ids) + wpe(pos)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        if cfg.scan_layers:
+            x = ScannedBlocks(cfg, name="h")(
+                x, mask=attention_mask, deterministic=deterministic)
+        else:
+            for i in range(cfg.n_layer):
+                blk = Block
+                if cfg.remat:
+                    blk = nn.remat(Block, prevent_cse=False)
+                x = blk(cfg, name=f"h_{i}")(
+                    x, mask=attention_mask, deterministic=deterministic)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = wte.attend(x.astype(jnp.float32))
+
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels, attention_mask)
+
+
+def cross_entropy_loss(logits, labels, mask=None):
+    """Mean next-token cross entropy in fp32 with shift."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = labels[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)
+
+
+def num_params(config: GPTConfig) -> int:
+    """Approximate parameter count (for flops accounting)."""
+    C, L, V, Pn = config.n_embd, config.n_layer, config.vocab_size, config.n_positions
+    per_layer = 12 * C * C + 13 * C
+    return V * C + Pn * C + L * per_layer + 2 * C
+
+
+def train_flops_per_token(config: GPTConfig) -> float:
+    """6N + attention flops per token (standard accounting)."""
+    N = num_params(config) - config.vocab_size * config.n_embd  # non-embedding
+    return 6.0 * N

@@ -127,12 +127,24 @@ class MeshTopology:
 
     # -- coordinate queries (parity: ProcessTopology.get_coord) ------------
     def coord_of(self, flat_rank: int) -> Dict[str, int]:
+        """Coordinates of a LOGICAL mesh position (row-major index into the
+        mesh array). On real TPU slices ``_arrange`` permutes devices for ICI
+        locality, so a logical position is generally NOT the device's index in
+        ``jax.devices()`` — use :meth:`coord_of_device` to query by device."""
         shape = tuple(self.axis_sizes[a] for a in AXIS_ORDER)
         coords = np.unravel_index(flat_rank, shape)
         return dict(zip(AXIS_ORDER, (int(c) for c in coords)))
 
+    def coord_of_device(self, device) -> Dict[str, int]:
+        """Mesh coordinates of a physical jax device."""
+        for idx, dev in np.ndenumerate(self.mesh.devices):
+            if dev == device:
+                return dict(zip(AXIS_ORDER, (int(c) for c in idx)))
+        raise ValueError(f"device {device} is not in this mesh")
+
     def filter_ranks(self, **axis_values) -> List[int]:
-        """All flat ranks whose coordinates match the given axis values
+        """All LOGICAL mesh positions (row-major, see coord_of) whose
+        coordinates match the given axis values
         (parity: ProcessTopology.filter_match, pipe/topology.py)."""
         out = []
         for r in range(self.num_devices):

@@ -410,6 +410,12 @@ class DeepSpeedConfig:
         micro = self.train_micro_batch_size_per_gpu
         gas = self.gradient_accumulation_steps
 
+        for name, v in ((C.TRAIN_BATCH_SIZE, train),
+                        (C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, micro),
+                        (C.GRADIENT_ACCUMULATION_STEPS, gas)):
+            if v is not None and v <= 0:
+                raise DeepSpeedConfigError(f"{name} must be positive, got {v}")
+
         if train is not None and micro is not None and gas is not None:
             pass
         elif train is not None and micro is not None:

@@ -1,0 +1,95 @@
+"""Data loading.
+
+Parity with reference ``deepspeed/runtime/dataloader.py`` (DeepSpeedDataLoader
+:33 with DP-sharded DistributedSampler, RepeatingLoader :10). TPU re-design:
+one host process drives many devices, so the loader yields **global** batches
+of ``micro_batch_per_device * dp_world`` and the engine device-puts them with
+the batch PartitionSpec — the sharded transfer replaces the per-rank sampler.
+"""
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+def default_collate(samples):
+    """Stack a list of dict/array samples into one batch."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack(cols) for cols in zip(*samples))
+    return np.stack(samples)
+
+
+class DeepSpeedDataLoader:
+    """Iterates a map-style dataset in global batches.
+
+    ``batch_size`` is the GLOBAL micro batch (micro_batch_per_device * dp).
+    Sharding across DP ranks happens at device_put time in the engine, which
+    is the SPMD equivalent of the reference's DistributedSampler split.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate_fn: Optional[Callable] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate
+        self.epoch = 0
+        if drop_last:
+            self.num_batches = len(dataset) // batch_size
+        else:
+            self.num_batches = math.ceil(len(dataset) / batch_size)
+        if self.num_batches == 0:
+            raise ValueError(
+                f"dataset of {len(dataset)} samples yields zero batches of "
+                f"global size {batch_size}"
+            )
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.num_batches
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(order)
+        for b in range(self.num_batches):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            samples = [self.dataset[int(i)] for i in idx]
+            yield self.collate_fn(samples)
+
+
+class RepeatingLoader:
+    """reference dataloader.py:10 — restart the wrapped loader at exhaustion."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

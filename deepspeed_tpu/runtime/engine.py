@@ -1,0 +1,669 @@
+"""The training engine.
+
+Parity with reference ``deepspeed/runtime/engine.py`` (DeepSpeedEngine :179,
+3.3k LoC): same lifecycle — ``initialize()`` → engine with
+``forward/backward/step`` (and the fused ``train_batch``), gradient
+accumulation boundaries, loss scaling, clipping, checkpoint save/load,
+throughput/wall-clock telemetry.
+
+TPU re-design (SURVEY.md §7): the hook-driven imperative engine collapses into
+two compiled SPMD programs over a named mesh —
+
+* ``_fwd_bwd``: value_and_grad of the (scaled) loss, accumulated into a grad
+  buffer whose sharding encodes ZeRO stage (replicated → psum at use; sharded
+  over fsdp → reduce-scatter), replacing the per-param backward hooks and
+  bucketed reducers of stage_1_and_2.py:832-1038.
+* ``_apply``: unscale → global-norm clip → overflow-gated optimizer update →
+  loss-scale update, all under ``lax.cond`` (reference does this host-side in
+  fused_optimizer.py:147 / stage_1_and_2.py:1744).
+
+Parameter construction is jitted with output shardings (the ``zero.Init``
+equivalent — params materialize already partitioned; reference
+partition_parameters.py:537 hijacks nn.Module.__init__ for this).
+"""
+
+import os
+import time
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization
+
+from deepspeed_tpu.comm.logging import comms_logger
+from deepspeed_tpu.parallel.mesh import (
+    MeshTopology,
+    set_default_topology,
+    topology_from_config,
+)
+from deepspeed_tpu.runtime.checkpoint_engine import (
+    CheckpointEngine,
+    MsgpackCheckpointEngine,
+)
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.loss_scaler import (
+    LossScaleState,
+    has_overflow,
+    init_loss_scale,
+    update_loss_scale,
+)
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRScheduler,
+    build_lr_scheduler,
+    schedule_fn_from_config,
+)
+from deepspeed_tpu.runtime.optimizer import build_optimizer
+from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+FORWARD_MICRO_TIMER = "fwd_bwd_microstep"
+STEP_MICRO_TIMER = "step_microstep"
+
+
+def initialize(
+    args=None,
+    model=None,
+    optimizer=None,
+    model_parameters=None,
+    training_data=None,
+    lr_scheduler=None,
+    topology: Optional[MeshTopology] = None,
+    dist_init_required: Optional[bool] = None,
+    collate_fn: Optional[Callable] = None,
+    config=None,
+    config_params=None,
+    sample_batch=None,
+    seed: int = 0,
+):
+    """Build the engine (reference deepspeed/__init__.py:51).
+
+    Returns the reference 4-tuple ``(engine, optimizer, dataloader,
+    lr_scheduler)``. ``model`` is a flax Module whose ``__call__(**batch)``
+    returns a scalar loss (the JAX model contract replacing nn.Module;
+    SURVEY.md §7 hard part (b)). ``optimizer`` may be an optax
+    GradientTransformation to override the config block; ``lr_scheduler`` an
+    LRScheduler or trace-safe ``step -> lr`` callable.
+    """
+    from deepspeed_tpu import comm
+
+    assert model is not None, "deepspeed_tpu.initialize: model is required"
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    assert config is not None, "deepspeed_tpu.initialize: config is required"
+
+    if dist_init_required is None or dist_init_required:
+        comm.init_distributed()
+
+    # Pipeline-module dispatch (reference __init__.py:123-147)
+    from deepspeed_tpu.runtime.pipe import PipelineModule  # lazy, avoids cycle
+
+    if isinstance(model, PipelineModule):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+        engine = PipelineEngine(
+            model=model, config=config, topology=topology,
+            optimizer=optimizer, lr_scheduler=lr_scheduler, seed=seed,
+        )
+    else:
+        engine = DeepSpeedEngine(
+            model=model,
+            config=config,
+            topology=topology,
+            optimizer=optimizer,
+            lr_scheduler=lr_scheduler,
+            sample_batch=sample_batch,
+            seed=seed,
+        )
+
+    dataloader = None
+    if training_data is not None:
+        dataloader = engine.deepspeed_io(training_data, collate_fn=collate_fn)
+
+    return engine, engine.optimizer_adapter, dataloader, engine.lr_scheduler
+
+
+class OptimizerAdapter:
+    """Host-side view of the sharded optimizer state with the torch-optim
+    attribute surface the reference returns from initialize()."""
+
+    def __init__(self, engine: "DeepSpeedEngine"):
+        self._engine = engine
+
+    @property
+    def state(self):
+        return self._engine._opt_state
+
+    @property
+    def param_groups(self):
+        lr = self._engine.get_lr()[0]
+        return [{"lr": lr, "params": []}]
+
+    def state_dict(self):
+        return serialization.to_state_dict(self._engine._opt_state)
+
+
+class DeepSpeedEngine:
+    def __init__(
+        self,
+        model,
+        config,
+        topology: Optional[MeshTopology] = None,
+        optimizer=None,
+        lr_scheduler=None,
+        sample_batch=None,
+        seed: int = 0,
+    ):
+        self.module = model
+        if not isinstance(config, DeepSpeedConfig):
+            # resolve triad after topology is known
+            config = DeepSpeedConfig(config)
+        self._config = config
+
+        if topology is None:
+            topology = topology_from_config(config.tpu.mesh_config)
+        self.topology = topology
+        set_default_topology(topology)
+        # (re)resolve the batch triad against the actual mesh; also validates
+        # a pre-resolved triad for consistency with this topology
+        config._resolve_batch_triad(topology.data_parallel_size)
+
+        comms_logger.configure(config.comms_logger)
+
+        self.zero_stage = config.zero_config.stage
+        self.sharding_rules = ZeroShardingRules(
+            topology,
+            stage=self.zero_stage,
+            param_persistence_threshold=config.zero_config.param_persistence_threshold
+            if self.zero_stage >= 3 else 0,
+            tp_rules=getattr(model, "tp_rules", None),
+        )
+
+        self.fp16_enabled = config.fp16.enabled
+        self.bfloat16_enabled = config.bf16.enabled
+        self.gradient_accumulation_steps = config.gradient_accumulation_steps
+        self.train_micro_batch_size_per_gpu = config.train_micro_batch_size_per_gpu
+        self.train_batch_size = config.train_batch_size
+        self.gradient_clipping = config.gradient_clipping
+
+        # optimizer + schedule
+
+        self.lr_scheduler, self._schedule_fn = self._configure_lr(lr_scheduler)
+        self._tx = self._configure_optimizer(optimizer)
+        self.optimizer_adapter = OptimizerAdapter(self)
+
+        self.checkpoint_engine: CheckpointEngine = MsgpackCheckpointEngine()
+
+        # runtime state (device) — params/opt created lazily at first batch
+        self._params = None
+        self._opt_state = None
+        self._acc_grads = None
+        self._ls_state, self._ls_config = init_loss_scale(
+            self._config.fp16, enabled=self.fp16_enabled
+        )
+        self._initialized = False
+        self._rng = jax.random.PRNGKey(seed)
+
+        # host counters
+        self.micro_steps = 0
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self.global_samples = 0
+        self._last_loss = None
+        self._backward_pending = False
+        self._step_losses = []
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size,
+            steps_per_output=config.steps_per_print,
+        )
+        self.wall_clock_breakdown = config.wall_clock_breakdown
+
+        self.monitor = self._configure_monitor()
+
+        # compiled fns (built on first use)
+        self._fwd_bwd_fn = None
+        self._apply_fn = None
+        self._eval_fn = None
+
+        log_dist(
+            f"DeepSpeedEngine: mesh={topology}, zero_stage={self.zero_stage}, "
+            f"dtype={config.precision_dtype}, micro_bs={self.train_micro_batch_size_per_gpu}, "
+            f"gas={self.gradient_accumulation_steps}",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def _configure_lr(self, lr_scheduler):
+        cfg = self._config
+        if lr_scheduler is None and cfg.scheduler.type is not None:
+            sched_fn = schedule_fn_from_config(cfg.scheduler.type, cfg.scheduler.params)
+            return build_lr_scheduler(cfg.scheduler.type, cfg.scheduler.params), sched_fn
+        if isinstance(lr_scheduler, LRScheduler):
+            return lr_scheduler, lr_scheduler.schedule_fn
+        if callable(lr_scheduler):
+            return LRScheduler(lr_scheduler), lr_scheduler
+        return None, None
+
+    def _configure_optimizer(self, client_optimizer):
+        cfg = self._config
+        if client_optimizer is not None:
+            if isinstance(client_optimizer, optax.GradientTransformation):
+                return client_optimizer
+            raise TypeError(
+                "optimizer must be an optax.GradientTransformation; the "
+                "reference's torch.optim objects have no TPU meaning"
+            )
+        lr = self._schedule_fn  # None -> use params lr
+        return build_optimizer(cfg.optimizer.type, cfg.optimizer.params, lr)
+
+    def _configure_monitor(self):
+        try:
+            from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+            return MonitorMaster(self._config)
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------------
+    # lazy state init (zero.Init equivalent)
+    # ------------------------------------------------------------------
+    def _init_state(self, batch: Dict[str, Any]):
+        model = self.module
+        rng = self._rng
+        init_rngs = {"params": rng, "dropout": jax.random.fold_in(rng, 1)}
+
+        def init_fn(rngs):
+            return model.init(rngs, **batch, deterministic=True)["params"]
+
+        param_shapes = jax.eval_shape(init_fn, init_rngs)
+        self._param_shardings = self.sharding_rules.param_sharding_tree(param_shapes)
+        self._grad_shardings = self.sharding_rules.grad_sharding_tree(param_shapes)
+
+        t0 = time.time()
+        self._params = jax.jit(init_fn, out_shardings=self._param_shardings)(init_rngs)
+        opt_shapes = jax.eval_shape(self._tx.init, param_shapes)
+        self._opt_shardings = self.sharding_rules.opt_sharding_tree(opt_shapes)
+        self._opt_state = jax.jit(
+            self._tx.init, out_shardings=self._opt_shardings
+        )(self._params)
+        self._acc_grads = jax.jit(
+            lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p),
+            out_shardings=self._grad_shardings,
+        )(self._params)
+        self._initialized = True
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(self._params))
+        log_dist(
+            f"engine state materialized: {n_params/1e6:.1f}M params in "
+            f"{time.time()-t0:.1f}s (zero stage {self.zero_stage})",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _build_fwd_bwd(self):
+        model = self.module
+        gas = self.gradient_accumulation_steps
+
+        def fwd_bwd(params, acc_grads, batch, rng, scale):
+            def loss_fn(p):
+                loss = model.apply(
+                    {"params": p}, **batch, deterministic=False,
+                    rngs={"dropout": rng},
+                )
+                # loss scaled by 1/gas (reference engine.py:1789 -> :1596)
+                # and by the fp16 loss scale (loss_scaler.py)
+                return loss * (scale / gas), loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+            new_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc_grads, grads
+            )
+            return new_acc, loss
+
+        return jax.jit(
+            fwd_bwd,
+            donate_argnums=(1,),
+            out_shardings=(self._grad_shardings, None),
+        )
+
+    def _build_apply(self):
+        tx = self._tx
+        clip = self.gradient_clipping
+        check_fp16 = self.fp16_enabled
+        ls_config = self._ls_config
+
+        def apply_step(params, opt_state, acc_grads, ls_state):
+            grads = jax.tree.map(lambda g: g / ls_state.scale, acc_grads)
+            overflow = has_overflow(grads) if check_fp16 else jnp.bool_(False)
+            grad_norm = optax.global_norm(grads)
+            if clip and clip > 0:
+                factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+
+            def do_update(operand):
+                params, opt_state, grads = operand
+                updates, new_opt = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                return new_params, new_opt
+
+            def skip_update(operand):
+                params, opt_state, _ = operand
+                return params, opt_state
+
+            new_params, new_opt = jax.lax.cond(
+                overflow, skip_update, do_update, (params, opt_state, grads)
+            )
+            new_ls = update_loss_scale(ls_state, overflow, ls_config)
+            zero_acc = jax.tree.map(jnp.zeros_like, acc_grads)
+            return new_params, new_opt, zero_acc, new_ls, overflow, grad_norm
+
+        return jax.jit(
+            apply_step,
+            donate_argnums=(0, 1, 2),
+            out_shardings=(
+                self._param_shardings, self._opt_shardings, self._grad_shardings,
+                None, None, None,
+            ),
+        )
+
+    def _build_eval(self):
+        model = self.module
+
+        def eval_fn(params, batch):
+            return model.apply({"params": params}, **batch, deterministic=True)
+
+        return jax.jit(eval_fn)
+
+    # ------------------------------------------------------------------
+    # data
+    # ------------------------------------------------------------------
+    def deepspeed_io(self, dataset, collate_fn=None, shuffle=True):
+        """reference engine.py:1539 deepspeed_io -> DeepSpeedDataLoader."""
+        global_micro = (
+            self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
+        )
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=global_micro,
+            shuffle=shuffle,
+            drop_last=self._config.dataloader_drop_last or True,
+            collate_fn=collate_fn,
+        )
+
+    def _put_batch(self, batch: Dict[str, Any]):
+        sharding = self.topology.batch_sharding()
+        dp = self.topology.data_parallel_size
+        expected = self.train_micro_batch_size_per_gpu * dp
+
+        def put(x):
+            x = jnp.asarray(x)
+            if x.ndim == 0 or x.shape[0] % dp != 0:
+                raise ValueError(
+                    f"batch leading dim {x.shape} must be the global micro "
+                    f"batch (train_micro_batch_size_per_gpu * dp = "
+                    f"{self.train_micro_batch_size_per_gpu} * {dp} = {expected})"
+                )
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(put, batch)
+
+    # ------------------------------------------------------------------
+    # train API (reference forward/backward/step protocol)
+    # ------------------------------------------------------------------
+    def forward(self, batch: Dict[str, Any]):
+        """Compute loss for one micro batch. Gradients are computed fused with
+        the forward (JAX has no separate backward graph) and cached until
+        ``backward()`` commits them — same cost, same calling convention."""
+        batch = dict(batch)
+        if not self._initialized:
+            self._init_state(batch)
+        if self._fwd_bwd_fn is None:
+            self._fwd_bwd_fn = self._build_fwd_bwd()
+
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_MICRO_TIMER).start()
+        self.tput_timer.start()
+
+        device_batch = self._put_batch(batch)
+        self._rng, sub = jax.random.split(self._rng)
+        scale = self._ls_state.scale if self.fp16_enabled else jnp.float32(1.0)
+        # grads accumulate eagerly (the donated buffer is consumed here);
+        # backward() is the protocol-parity bookkeeping step
+        self._acc_grads, loss = self._fwd_bwd_fn(
+            self._params, self._acc_grads, device_batch, sub, scale
+        )
+        self._backward_pending = True
+        self._last_loss = loss
+        if self.wall_clock_breakdown:
+            self.timers(FORWARD_MICRO_TIMER).stop()
+        return loss
+
+    def backward(self, loss=None):
+        """Record the micro-step loss (reference engine.py:1764; the gradient
+        computation already ran fused with ``forward`` — JAX has no separate
+        backward graph)."""
+        assert self._backward_pending, (
+            "backward() must follow forward() (fused grad computation)"
+        )
+        self._backward_pending = False
+        self._step_losses.append(self._last_loss)
+        return loss if loss is not None else self._last_loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        """reference engine.py:1855."""
+        return (self.micro_steps + 1) % self.gradient_accumulation_steps == 0
+
+    def step(self):
+        """reference engine.py:1971 — model step only at the GAS boundary."""
+        at_boundary = self.is_gradient_accumulation_boundary()
+        if at_boundary:
+            self._take_model_step()
+        self.micro_steps += 1
+        self.global_samples += (
+            self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
+        )
+        self.tput_timer.stop(global_step=at_boundary)
+
+    def _take_model_step(self):
+        if self._apply_fn is None:
+            self._apply_fn = self._build_apply()
+        if self.wall_clock_breakdown:
+            self.timers(STEP_MICRO_TIMER).start()
+        (
+            self._params, self._opt_state, self._acc_grads,
+            self._ls_state, overflow, grad_norm,
+        ) = self._apply_fn(
+            self._params, self._opt_state, self._acc_grads, self._ls_state
+        )
+        self.global_steps += 1
+        if self.fp16_enabled and bool(overflow):
+            self.skipped_steps += 1
+            log_dist(
+                f"overflow at step {self.global_steps}; loss scale -> "
+                f"{float(self._ls_state.scale)}", ranks=[0],
+            )
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.wall_clock_breakdown:
+            self.timers(STEP_MICRO_TIMER).stop()
+            self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER])
+        if self.global_steps % self._config.steps_per_print == 0:
+            self._report_progress()
+        if self.monitor is not None and self._step_losses:
+            self.monitor.write_events(
+                [("Train/Samples/train_loss",
+                  float(np.mean([float(l) for l in self._step_losses])),
+                  self.global_samples)]
+            )
+        self._step_losses = []
+
+    def train_batch(self, data_iter):
+        """Full effective-batch step: gas micro steps + model update
+        (PipelineEngine.train_batch parity, pipe/engine.py:296). Returns the
+        mean micro loss."""
+        losses = []
+        for _ in range(self.gradient_accumulation_steps):
+            batch = next(data_iter)
+            loss = self.forward(batch)
+            self.backward()
+            losses.append(loss)
+            self.step()
+        return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
+
+    def eval_batch(self, batch: Dict[str, Any]):
+        batch = dict(batch)
+        if not self._initialized:
+            self._init_state(batch)
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval()
+        return self._eval_fn(self._params, self._put_batch(batch))
+
+    def __call__(self, batch):
+        return self.eval_batch(batch)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_last_lr()
+        lr = self._config.optimizer.params.get("lr", 0.0)
+        return [lr]
+
+    def get_global_grad_norm(self):
+        return None  # populated after first step via _apply outputs if needed
+
+    @property
+    def loss_scale(self):
+        return float(self._ls_state.scale) if self._ls_state is not None else 1.0
+
+    @property
+    def params(self):
+        return self._params
+
+    def _report_progress(self):
+        lr = self.get_lr()
+        log_dist(
+            f"step={self.global_steps}, skipped={self.skipped_steps}, "
+            f"lr={lr}, loss_scale={self.loss_scale}",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    # checkpoint (reference engine.py:2545 load / :2889 save)
+    # ------------------------------------------------------------------
+    def _model_states_path(self, ckpt_dir, tag):
+        return os.path.join(ckpt_dir, str(tag), "mp_rank_00_model_states.msgpack")
+
+    def _engine_states_path(self, ckpt_dir, tag):
+        return os.path.join(ckpt_dir, str(tag), "engine_states.pkl")
+
+    def _optim_states_path(self, ckpt_dir, tag):
+        return os.path.join(
+            ckpt_dir, str(tag), "zero_pp_rank_0_mp_rank_00_optim_states.msgpack"
+        )
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        assert self._initialized, "cannot checkpoint before first batch"
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        client_state = client_state or {}
+
+        self.checkpoint_engine.save(
+            {"module": serialization.to_state_dict(self._params)},
+            self._model_states_path(save_dir, tag),
+        )
+        meta = {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler else {}),
+            "client_state": client_state,
+        }
+        import pickle
+
+        with open(self._engine_states_path(save_dir, tag), "wb") as f:
+            pickle.dump(meta, f)
+        optim_state = {
+            "optimizer": serialization.to_state_dict(self._opt_state),
+            "loss_scale": {
+                "scale": np.float32(self._ls_state.scale),
+                "good_steps": np.int32(self._ls_state.good_steps),
+                "hysteresis": np.int32(self._ls_state.hysteresis),
+            },
+        }
+        self.checkpoint_engine.save(
+            optim_state, self._optim_states_path(save_dir, tag)
+        )
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        self.checkpoint_engine.commit(tag)
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True):
+        if tag is None:
+            latest_path = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest_path):
+                logger.warning("no 'latest' file at %s", load_dir)
+                return None, {}
+            with open(latest_path) as f:
+                tag = f.read().strip()
+
+        assert self._initialized, (
+            "run one forward (or init) before load_checkpoint so state "
+            "templates exist"
+        )
+        model_state = self.checkpoint_engine.load(
+            self._model_states_path(load_dir, tag)
+        )
+        import pickle
+
+        with open(self._engine_states_path(load_dir, tag), "rb") as f:
+            meta = pickle.load(f)
+        restored = serialization.from_state_dict(self._params, model_state["module"])
+        self._params = jax.jit(
+            lambda t: t, out_shardings=self._param_shardings
+        )(restored)
+        self.global_steps = int(meta["global_steps"])
+        self.global_samples = int(meta["global_samples"])
+        self.micro_steps = int(meta["micro_steps"])
+        self.skipped_steps = int(meta["skipped_steps"])
+        if load_lr_scheduler_states and self.lr_scheduler is not None and (
+            meta.get("lr_scheduler")
+        ):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+
+        if load_optimizer_states:
+            optim_state = self.checkpoint_engine.load(
+                self._optim_states_path(load_dir, tag)
+            )
+            restored_opt = serialization.from_state_dict(
+                self._opt_state, optim_state["optimizer"]
+            )
+            self._opt_state = jax.jit(
+                lambda t: t, out_shardings=self._opt_shardings
+            )(restored_opt)
+            ls = optim_state.get("loss_scale", {})
+            if ls and self._ls_state is not None:
+                self._ls_state = self._ls_state._replace(
+                    scale=jnp.float32(ls["scale"]),
+                    good_steps=jnp.int32(ls["good_steps"]),
+                    hysteresis=jnp.int32(ls["hysteresis"]),
+                )
+        return tag, meta.get("client_state", {})

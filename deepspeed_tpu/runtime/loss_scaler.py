@@ -1,0 +1,103 @@
+"""Loss scaling for fp16 training.
+
+Parity with reference ``deepspeed/runtime/fp16/loss_scaler.py`` (LossScaler
+:54 static, DynamicLossScaler :77). TPU re-design: the scaler state is a
+jittable pytree (arrays only) threaded through the compiled train step; the
+static policy lives in LossScaleConfig, closed over at trace time. The
+overflow check and skip-update decision happen inside the step via
+``lax.cond`` (reference does it host-side between CUDA kernels — see
+SURVEY.md §7 hard part (c)).
+"""
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    """Device state (pytree of arrays)."""
+
+    scale: jnp.ndarray          # f32 scalar, current loss scale
+    good_steps: jnp.ndarray     # i32 scalar, consecutive non-overflow steps
+    hysteresis: jnp.ndarray     # i32 scalar, remaining tolerated overflows
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaleConfig:
+    """Static policy (trace-time constants)."""
+
+    dynamic: bool = False
+    scale_window: int = 1000
+    min_scale: float = 1.0
+    max_hysteresis: int = 1
+    scale_factor: float = 2.0
+
+
+def init_loss_scale(fp16_config=None, enabled: bool = True
+                    ) -> Tuple[LossScaleState, LossScaleConfig]:
+    """Build initial (state, policy) from an Fp16Config (runtime/config.py)."""
+    if fp16_config is None or not enabled:
+        state = LossScaleState(
+            scale=jnp.float32(1.0), good_steps=jnp.int32(0), hysteresis=jnp.int32(1)
+        )
+        return state, LossScaleConfig()
+    dynamic = fp16_config.dynamic_loss_scale
+    init_scale = (2.0 ** fp16_config.initial_scale_power if dynamic
+                  else float(fp16_config.loss_scale))
+    state = LossScaleState(
+        scale=jnp.float32(init_scale),
+        good_steps=jnp.int32(0),
+        hysteresis=jnp.int32(fp16_config.hysteresis),
+    )
+    cfg = LossScaleConfig(
+        dynamic=dynamic,
+        scale_window=int(fp16_config.loss_scale_window),
+        min_scale=float(fp16_config.min_loss_scale),
+        max_hysteresis=int(fp16_config.hysteresis),
+        scale_factor=2.0,
+    )
+    return state, cfg
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """Global inf/nan check over a grad pytree (reference CheckOverflow,
+    runtime/utils.py; the cross-rank allreduce of the flag is implicit in
+    SPMD — every device computes the same reduction)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.bool_(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def update_loss_scale(state: LossScaleState, overflow,
+                      cfg: LossScaleConfig) -> LossScaleState:
+    """Dynamic loss-scale update (reference DynamicLossScaler.update_scale):
+    on overflow consume hysteresis then halve; after ``scale_window`` clean
+    steps, double."""
+    if not cfg.dynamic:
+        return state
+
+    def on_overflow(s):
+        new_hyst = s.hysteresis - 1
+        drop = new_hyst <= 0
+        new_scale = jnp.where(
+            drop, jnp.maximum(s.scale / cfg.scale_factor, cfg.min_scale), s.scale
+        )
+        return LossScaleState(
+            scale=new_scale,
+            good_steps=jnp.int32(0),
+            hysteresis=jnp.where(drop, jnp.int32(cfg.max_hysteresis), new_hyst),
+        )
+
+    def on_good(s):
+        grew = (s.good_steps + 1) >= cfg.scale_window
+        return LossScaleState(
+            scale=jnp.where(grew, s.scale * cfg.scale_factor, s.scale),
+            good_steps=jnp.where(grew, jnp.int32(0), s.good_steps + 1),
+            hysteresis=jnp.int32(cfg.max_hysteresis),
+        )
+
+    return jax.lax.cond(overflow, on_overflow, on_good, state)

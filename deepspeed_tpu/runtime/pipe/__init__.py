@@ -1,0 +1,3 @@
+"""Pipeline parallelism (reference deepspeed/runtime/pipe/)."""
+
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule  # noqa: F401

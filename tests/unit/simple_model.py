@@ -1,0 +1,57 @@
+"""Tiny test-fixture models and data (parity with reference
+tests/unit/simple_model.py: SimpleModel + random_dataloader)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    """Two-linear regression model; __call__(x, y) -> mse loss."""
+
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x, y=None, deterministic=True):
+        for i in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim, name=f"linear_{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(1, name="head")(x)
+        if y is None:
+            return x
+        return jnp.mean((x - y) ** 2)
+
+
+def random_dataset(total_samples=64, in_dim=16, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(total_samples, in_dim).astype(np.float32)
+    w = rng.randn(in_dim, 1).astype(np.float32)
+    ys = xs @ w + 0.01 * rng.randn(total_samples, 1).astype(np.float32)
+    return [{"x": xs[i], "y": ys[i]} for i in range(total_samples)]
+
+
+def tiny_gpt_config(**overrides):
+    from deepspeed_tpu.models.transformer_lm import GPTConfig
+
+    base = dict(
+        vocab_size=128,
+        n_positions=64,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+def random_token_batches(num_batches, batch_size, seq_len, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(num_batches):
+        ids = rng.randint(0, vocab, size=(batch_size, seq_len)).astype(np.int32)
+        out.append({"input_ids": ids, "labels": ids})
+    return out

@@ -1,0 +1,209 @@
+"""Engine tests (parity with reference tests/unit/runtime/test_ds_initialize.py,
+half_precision tests, and checkpoint round-trips)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+from unit.simple_model import (
+    SimpleModel,
+    random_dataset,
+    random_token_batches,
+    tiny_gpt_config,
+)
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def make_engine(config=None, model=None, data=None):
+    model = model or SimpleModel(hidden_dim=16)
+    data = data if data is not None else random_dataset(128)
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=model, config=config or base_config(), training_data=data
+    )
+    return engine, iter(RepeatingLoader(loader))
+
+
+def test_initialize_returns_tuple(eight_devices):
+    engine, opt, loader, sched = deepspeed_tpu.initialize(
+        model=SimpleModel(), config=base_config(), training_data=random_dataset(64)
+    )
+    assert engine is not None and opt is not None and loader is not None
+    assert sched is None  # no scheduler block
+
+
+def test_train_loss_decreases(eight_devices):
+    engine, it = make_engine()
+    losses = [float(engine.train_batch(it)) for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_forward_backward_step_protocol(eight_devices):
+    engine, it = make_engine()
+    batch = next(it)
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()
+    assert engine.global_steps == 1
+    assert engine.micro_steps == 1
+    # backward without forward raises
+    with pytest.raises(AssertionError):
+        engine.backward()
+
+
+def test_gradient_accumulation_boundary(eight_devices):
+    engine, it = make_engine(base_config(gradient_accumulation_steps=4))
+    for i in range(4):
+        engine.forward(next(it))
+        engine.backward()
+        assert engine.is_gradient_accumulation_boundary() == (i == 3)
+        engine.step()
+    assert engine.global_steps == 1
+    assert engine.micro_steps == 4
+
+
+def test_grad_accum_equivalent_to_large_batch(eight_devices):
+    """gas=2 @ micro 4 must match gas=1 @ micro 8 after one model step."""
+    data = random_dataset(128)
+
+    def run(micro, gas):
+        cfg = base_config(
+            train_micro_batch_size_per_gpu=micro,
+            gradient_accumulation_steps=gas,
+            optimizer={"type": "SGD", "params": {"lr": 0.1}},
+        )
+        engine, _, loader, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=8), config=cfg, training_data=data
+        )
+        it = iter(RepeatingLoader(loader))
+        engine.train_batch(it)
+        return jax.tree.leaves(engine.params)
+
+    p_acc = run(micro=4, gas=2)
+    p_big = run(micro=8, gas=1)
+    for a, b in zip(p_acc, p_big):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_fp16_loss_scaling(eight_devices):
+    cfg = base_config(
+        fp16={"enabled": True, "initial_scale_power": 8, "loss_scale_window": 4,
+              "hysteresis": 1},
+    )
+    engine, it = make_engine(cfg)
+    assert engine.loss_scale == 2.0 ** 8
+    for _ in range(6):
+        engine.train_batch(it)
+    # 4-step window with no overflow -> scale grew
+    assert engine.loss_scale > 2.0 ** 8
+    assert engine.skipped_steps == 0
+
+
+def test_fp16_overflow_skips_step(eight_devices):
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 4,
+                            "hysteresis": 1})
+    engine, it = make_engine(cfg)
+    engine.train_batch(it)
+    params_before = [np.asarray(x) for x in jax.tree.leaves(engine.params)]
+    # poison one micro batch -> overflow -> step skipped, scale halved
+    gb = 4 * engine.topology.data_parallel_size
+    bad = {"x": np.full((gb, 16), np.inf, np.float32),
+           "y": np.ones((gb, 1), np.float32)}
+    engine.forward(bad)
+    engine.backward()
+    engine.step()
+    assert engine.skipped_steps == 1
+    assert engine.loss_scale == 2.0 ** 3
+    for before, after in zip(params_before, jax.tree.leaves(engine.params)):
+        np.testing.assert_array_equal(before, np.asarray(after))
+
+
+def test_bf16_training(eight_devices):
+    cfg = base_config(bf16={"enabled": True})
+    config = tiny_gpt_config(dtype=jnp.bfloat16)
+    from deepspeed_tpu.models.transformer_lm import GPT
+
+    batches = random_token_batches(4, 8, 32, config.vocab_size)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(config), config=cfg
+    )
+    losses = []
+    for i in range(10):
+        b = batches[i % len(batches)]
+        engine.forward(b)
+        engine.backward()
+        engine.step()
+        losses.append(float(engine._last_loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_scheduler_from_config(eight_devices):
+    cfg = base_config(
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_num_steps": 10, "warmup_max_lr": 0.01,
+                              "warmup_type": "linear"}},
+    )
+    engine, it = make_engine(cfg)
+    engine.train_batch(it)
+    lr1 = engine.get_lr()[0]
+    for _ in range(20):
+        engine.train_batch(it)
+    lr2 = engine.get_lr()[0]
+    assert lr2 > lr1
+    assert abs(lr2 - 0.01) < 1e-6
+
+
+def test_checkpoint_roundtrip(eight_devices, tmp_path):
+    engine, it = make_engine()
+    for _ in range(3):
+        engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path), client_state={"note": "hello"})
+    ref = [np.asarray(x) for x in jax.tree.leaves(engine.params)]
+    ref_steps = engine.global_steps
+    for _ in range(3):
+        engine.train_batch(it)
+    tag, client = engine.load_checkpoint(str(tmp_path))
+    assert tag == f"global_step{ref_steps}"
+    assert client["note"] == "hello"
+    assert engine.global_steps == ref_steps
+    for a, b in zip(ref, jax.tree.leaves(engine.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_checkpoint_resume_training_identical(eight_devices, tmp_path):
+    """Save -> train 2 -> load -> train 2 again must reproduce exactly
+    (optimizer state restored)."""
+    engine, it_unused = make_engine()
+    fixed = random_dataset(32, seed=7)
+    loader = engine.deepspeed_io(fixed, shuffle=False)
+
+    def two_steps():
+        it = iter(RepeatingLoader(loader))
+        return [float(engine.train_batch(it)) for _ in range(2)]
+
+    two_steps()
+    engine.save_checkpoint(str(tmp_path))
+    run1 = two_steps()
+    engine.load_checkpoint(str(tmp_path))
+    run2 = two_steps()
+    np.testing.assert_allclose(run1, run2, rtol=1e-6)
+
+
+def test_eval_batch(eight_devices):
+    engine, it = make_engine()
+    batch = next(it)
+    out = engine.eval_batch({"x": batch["x"]})
+    assert out.shape == (4 * engine.topology.data_parallel_size, 1)
