@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Benchmark: GPT-2 125M bf16 training throughput on one TPU chip.
+
+Mirrors BASELINE config 2 (GPT-2 125M, fused adam, bf16, DP) on the available
+hardware. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline normalizes achieved model TFLOPS against the reference's best
+published single-device number: 64 TFLOPS on 1x V100 for BERT-L seq-128
+pretraining (reference docs/_posts/2020-05-28-fastest-bert-training.md:36,
+see BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+BASELINE_TFLOPS = 64.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer_lm import (
+        GPT,
+        gpt2_config,
+        num_params,
+    )
+
+    seq = 1024
+    micro = 8
+    cfg = gpt2_config(
+        "gpt2-125m",
+        n_positions=seq,
+        dtype=jnp.bfloat16,
+        scan_layers=True,
+        remat=True,
+    )
+    model = GPT(cfg)
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "optimizer": {
+            "type": "FusedAdam",
+            "params": {"lr": 6e-4, "betas": [0.9, 0.95], "weight_decay": 0.1},
+        },
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
+
+    n_dev = engine.topology.num_devices
+    gb = micro * engine.topology.data_parallel_size
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": rng.randint(0, cfg.vocab_size, size=(gb, seq)).astype(np.int32)
+    }
+    batch["labels"] = batch["input_ids"]
+
+    def one_step():
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+
+    # compile + warmup
+    one_step()
+    one_step()
+    jax.block_until_ready(jax.tree.leaves(engine.params)[0])
+
+    steps = 10
+    t0 = time.time()
+    for _ in range(steps):
+        one_step()
+    jax.block_until_ready(jax.tree.leaves(engine.params)[0])
+    dt = (time.time() - t0) / steps
+
+    tokens_per_step = gb * seq
+    n_params = num_params(cfg)
+    embed = cfg.vocab_size * cfg.n_embd
+    # model flops/token: 6*(N - embed) matmul + causal attention
+    attn = 6 * cfg.n_layer * cfg.n_embd * seq  # 12*L*C*s/2 (causal)
+    flops_per_token = 6.0 * (n_params - embed) + attn
+    tflops = tokens_per_step * flops_per_token / dt / 1e12 / n_dev
+    samples_per_sec = gb / dt
+
+    result = {
+        "metric": "gpt2_125m_bf16_train_tflops_per_chip",
+        "value": round(tflops, 2),
+        "unit": "TFLOPS",
+        "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
+        "samples_per_sec": round(samples_per_sec, 2),
+        "ms_per_step": round(dt * 1000, 1),
+        "seq_len": seq,
+        "global_batch": gb,
+        "n_devices": n_dev,
+        "params_m": round(n_params / 1e6, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

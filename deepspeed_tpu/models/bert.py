@@ -123,11 +123,34 @@ class BertEncoder(nn.Module):
         return x
 
 
+def bert_tp_rules(path: str, shape):
+    """Megatron-style TP specs for BERT params (see gpt_tp_rules)."""
+    from jax.sharding import PartitionSpec
+
+    ndim = len(shape)
+
+    def dim(i):
+        spec = [None] * ndim
+        spec[i] = "tp"
+        return PartitionSpec(*spec)
+
+    if path.endswith(("attention/qkv/kernel", "attention/qkv/bias",
+                      "intermediate/kernel", "intermediate/bias")):
+        return dim(-1)  # column parallel
+    if path.endswith("output/kernel"):  # both attention/output and FFN output
+        return dim(-2)  # row parallel
+    if path.endswith("word_embeddings/embedding"):
+        return dim(0)
+    return None
+
+
 class BertForPreTraining(nn.Module):
     """BERT with MLM head (tied embeddings). ``__call__`` returns masked-LM
     loss when ``labels`` given (-100 = ignore), else logits."""
 
     config: BertConfig
+
+    tp_rules = staticmethod(bert_tp_rules)
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,

@@ -160,12 +160,41 @@ class ScannedBlocks(nn.Module):
         return x
 
 
+def gpt_tp_rules(path: str, shape) -> "PartitionSpec":
+    """Megatron-style tensor-parallel PartitionSpecs for GPT params
+    (reference delegates training TP to a user mpu, engine.py:189; inference
+    TP slices the same weights in module_inject/replace_module.py:18 —
+    column-parallel qkv/fc1, row-parallel proj/fc2, vocab-parallel embedding).
+    Consumed by ZeroShardingRules; dims not divisible by the tp axis are
+    stripped there."""
+    from jax.sharding import PartitionSpec
+
+    ndim = len(shape)
+
+    def dim(i):
+        spec = [None] * ndim
+        spec[i] = "tp"
+        return PartitionSpec(*spec)
+
+    if path.endswith(("attn/c_attn/kernel", "mlp/c_fc/kernel",
+                      "attn/c_attn/bias", "mlp/c_fc/bias")):
+        return dim(-1)  # column parallel
+    if path.endswith(("attn/c_proj/kernel", "mlp/c_proj/kernel")):
+        return dim(-2)  # row parallel
+    if path.endswith("wte/embedding"):
+        return dim(0)   # vocab parallel (logits shard over vocab)
+    return None
+
+
 class GPT(nn.Module):
     """Decoder-only LM. ``__call__(batch)`` returns mean cross-entropy loss
     when ``batch["labels"]`` is present, else logits — the model contract the
     engine trains against (see runtime/engine.py)."""
 
     config: GPTConfig
+
+    # engine reads this for TP sharding (runtime/zero/sharding.py)
+    tp_rules = staticmethod(gpt_tp_rules)
 
     @nn.compact
     def __call__(self, input_ids, labels=None, attention_mask=None,

@@ -168,6 +168,22 @@ class DeepSpeedEngine:
 
         if topology is None:
             topology = topology_from_config(config.tpu.mesh_config)
+        # ZeRO shards over the fsdp axis: when the user asked for a ZeRO stage
+        # but left all data parallelism on `dp`, move it to `fsdp` (the mesh
+        # expression of "partition across the DP world",
+        # reference stage_1_and_2.py partitioning over the DP group)
+        if (config.zero_config.stage >= 1 and topology.size("fsdp") == 1
+                and topology.size("dp") > 1):
+            sizes = dict(topology.axis_sizes)
+            sizes["fsdp"] = sizes.pop("dp")
+            sizes["dp"] = 1
+            topology = MeshTopology(
+                **sizes, devices=list(topology.mesh.devices.flat)
+            )
+            log_dist(
+                f"zero stage {config.zero_config.stage}: data-parallel axis "
+                f"moved to fsdp ({topology})", ranks=[0],
+            )
         self.topology = topology
         set_default_topology(topology)
         # (re)resolve the batch triad against the actual mesh; also validates
@@ -292,7 +308,9 @@ class DeepSpeedEngine:
         t0 = time.time()
         self._params = jax.jit(init_fn, out_shardings=self._param_shardings)(init_rngs)
         opt_shapes = jax.eval_shape(self._tx.init, param_shapes)
-        self._opt_shardings = self.sharding_rules.opt_sharding_tree(opt_shapes)
+        self._opt_shardings = self.sharding_rules.opt_sharding_tree(
+            opt_shapes, param_shapes
+        )
         self._opt_state = jax.jit(
             self._tx.init, out_shardings=self._opt_shardings
         )(self._params)
@@ -613,6 +631,22 @@ class DeepSpeedEngine:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
         self.checkpoint_engine.commit(tag)
+        return True
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.msgpack"):
+        """Gathered half-precision weights in one file (reference
+        engine.py:3289 save_16bit_model / :3219 _zero3_consolidated_16bit_
+        state_dict — there a cross-rank gather dance, here a device_get of the
+        logically-global params + a cast)."""
+        assert self._initialized, "cannot save before first batch"
+        # fp16 only when explicitly trained fp16; bfloat16 otherwise (range-
+        # safe native TPU 16-bit type, incl. for pure-fp32 training)
+        dtype = jnp.float16 if self.fp16_enabled else jnp.bfloat16
+        half = jax.tree.map(lambda x: jnp.asarray(x, dtype), self._params)
+        self.checkpoint_engine.save(
+            {"module": serialization.to_state_dict(half)},
+            os.path.join(save_dir, save_filename),
+        )
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,

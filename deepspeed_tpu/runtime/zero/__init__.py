@@ -1,0 +1,59 @@
+"""ZeRO public API surface.
+
+Reference ``deepspeed/runtime/zero/`` exports ``zero.Init`` and
+``GatheredParameters`` (partition_parameters.py:537 / :1512). In the TPU
+framework parameters are *logically global* arrays whose shards live where the
+PartitionSpec says — so "gathering" is a device_get / resharding, not a
+collective the user orchestrates. The classes below keep the API shape for
+ported user code.
+"""
+
+import contextlib
+
+from deepspeed_tpu.runtime.checkpoint_engine import _to_host
+from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules  # noqa: F401
+
+
+class Init(contextlib.AbstractContextManager):
+    """reference zero.Init (partition_parameters.py:537): construct a model
+    with params partitioned from the start. The TPU engine always materializes
+    params via jit with sharded out_shardings (engine._init_state), so this
+    context is a documented no-op kept for API parity; ``remote_device`` and
+    ``config_dict_or_path`` are accepted and recorded.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None):
+        self.enabled = enabled
+        self.remote_device = remote_device
+
+    def __exit__(self, *exc):
+        return False
+
+
+class GatheredParameters(contextlib.AbstractContextManager):
+    """reference GatheredParameters (partition_parameters.py:1512): inside the
+    context, the given params are available unpartitioned. Here: materializes
+    replicated host copies in ``.params``."""
+
+    def __init__(self, params, modifier_rank=None, fwd_module=None, enabled=True):
+        self._src = params
+        self.enabled = enabled
+        self.params = None
+
+    def __enter__(self):
+        if self.enabled:
+            self.params = gather_params(self._src)
+        else:
+            self.params = self._src
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def gather_params(params):
+    """Fully-replicated host copy of a (possibly sharded) param pytree —
+    the all-gather the reference does explicitly (partition_parameters.py:806)."""
+    return _to_host(params)

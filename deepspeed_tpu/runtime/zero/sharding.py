@@ -76,7 +76,23 @@ class ZeroShardingRules:
     def _tp_spec(self, path, shape) -> Optional[PartitionSpec]:
         if self.tp_rules is None:
             return None
-        return self.tp_rules(path, shape)
+        spec = self.tp_rules(path, shape)
+        if spec is None:
+            return None
+        # validate: strip axes whose dim is not divisible by the mesh axis size
+        cleaned = []
+        for i, axis in enumerate(spec):
+            if axis is None:
+                cleaned.append(None)
+                continue
+            size = self.topo.size(axis) if isinstance(axis, str) else int(
+                np.prod([self.topo.size(a) for a in axis])
+            )
+            # size-1 axes collapse to replicated; indivisible dims cannot shard
+            cleaned.append(axis if size > 1 and shape[i] % size == 0 else None)
+        if all(a is None for a in cleaned):
+            return None
+        return PartitionSpec(*cleaned)
 
     def param_spec(self, path, shape) -> PartitionSpec:
         tp = self._tp_spec(path, shape)
@@ -92,12 +108,18 @@ class ZeroShardingRules:
             return _spec_for_shape(shape, self.topo, tp_spec=tp)
         return tp if tp is not None else PartitionSpec()
 
-    def opt_state_spec_for_shape(self, shape, matching_param_spec=None) -> PartitionSpec:
-        if self.stage >= 1 and len(shape) > 0:
-            if matching_param_spec is not None:
-                return matching_param_spec
-            return _spec_for_shape(shape, self.topo)
-        return PartitionSpec()
+    def opt_state_spec(self, param_path: Optional[str], shape) -> PartitionSpec:
+        """Spec for an optimizer-state leaf. ``param_path`` is the path of the
+        param this leaf mirrors (mu/nu), or None for non-param-shaped state.
+        Stage >= 1 shards param-shaped state over fsdp (the reference's
+        optimizer-state partitioning, stage_1_and_2.py:634) composed with the
+        param's TP spec; stage 0 mirrors the param spec exactly."""
+        if not shape:
+            return PartitionSpec()
+        tp = self._tp_spec(param_path, shape) if param_path is not None else None
+        if self.stage >= 1:
+            return _spec_for_shape(shape, self.topo, tp_spec=tp)
+        return tp if tp is not None else PartitionSpec()
 
     # -- pytree builders ---------------------------------------------------
     def param_sharding_tree(self, params_shapes) -> Any:
@@ -120,16 +142,29 @@ class ZeroShardingRules:
         return _tree_map_with_path(leaf, params_shapes)
 
     def opt_sharding_tree(self, opt_state_shapes, params_shapes=None) -> Any:
-        """Shape-driven: any opt-state leaf gets the FSDP rule for its own
-        shape (mu/nu mirror param shapes so they co-shard; scalar counts stay
-        replicated). This avoids structural matching against optax internals."""
+        """Optimizer-state leaves that mirror a parameter (optax mu/nu subtrees
+        carry the param pytree, so their paths END with the param's path) get
+        that param's rule; everything else (counts, scalars) follows the plain
+        shape rule."""
         mesh = self.topo.mesh
+        param_paths = []
+        if params_shapes is not None:
+            flat = jax.tree_util.tree_flatten_with_path(params_shapes)[0]
+            param_paths = [
+                (_path_str(path), leaf.shape) for path, leaf in flat
+            ]
 
-        def leaf(leaf_shape):
-            spec = self.opt_state_spec_for_shape(leaf_shape.shape)
+        def leaf(path_s, leaf_shape):
+            # path_s is already stringified by _tree_map_with_path
+            matched = None
+            for ppath, pshape in param_paths:
+                if path_s.endswith(ppath) and tuple(pshape) == tuple(leaf_shape.shape):
+                    matched = ppath
+                    break
+            spec = self.opt_state_spec(matched, leaf_shape.shape)
             return NamedSharding(mesh, spec)
 
-        return jax.tree.map(leaf, opt_state_shapes)
+        return _tree_map_with_path(leaf, opt_state_shapes)
 
 
 def _tree_map_with_path(fn, tree):

@@ -1,0 +1,66 @@
+"""Consolidate a deepspeed_tpu checkpoint into a single fp32 weights file.
+
+Parity with reference ``deepspeed/utils/zero_to_fp32.py`` (482 LoC): that tool
+stitches per-rank ZeRO shard files back into one fp32 state_dict. Here
+checkpoints already store logically-global arrays (sharding is a runtime
+property, not a file layout), so consolidation is a cast + rewrite — the tool
+exists for workflow parity and for downcasting 16-bit model-only saves.
+
+Usage:
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output_file> [tag]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+from flax import serialization
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Load the model state dict from a checkpoint dir, cast to fp32."""
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}")
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(checkpoint_dir, str(tag), "mp_rank_00_model_states.msgpack")
+    with open(path, "rb") as f:
+        state = serialization.msgpack_restore(f.read())
+    module = state["module"]
+
+    def cast(x):
+        arr = np.asarray(x)
+        if arr.dtype.kind == "f" and arr.dtype != np.float32:
+            return arr.astype(np.float32)
+        return arr
+
+    import jax
+
+    return jax.tree.map(cast, module)
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file, tag=None):
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    payload = serialization.msgpack_serialize(sd)
+    with open(output_file, "wb") as f:
+        f.write(payload)
+    print(f"saved consolidated fp32 state dict to {output_file}")
+    return sd
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("tag", nargs="?", default=None)
+    args = parser.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, args.tag
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
