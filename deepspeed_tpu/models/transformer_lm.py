@@ -37,10 +37,24 @@ class GPTConfig:
     remat: bool = False
     scan_layers: bool = True
     use_flash_attention: bool = False  # Pallas kernel path (ops/pallas)
+    # MoE (reference deepspeed/moe/): 0 experts = dense MLP everywhere
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.0
+    moe_eval_capacity_factor: float = 1.0
+    moe_min_capacity: int = 4
+    moe_drop_tokens: bool = True
+    moe_aux_loss_coef: float = 0.01
+    moe_noisy_gate_policy: Optional[str] = None
+    moe_use_rts: bool = True
 
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
+
+    @property
+    def is_moe(self) -> bool:
+        return self.moe_num_experts > 0
 
 
 # GPT-2 sizes (reference benchmarks target 125M / 1.3B; BASELINE.md configs 2-5)
@@ -113,6 +127,10 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
+    """Pre-LN transformer block; MLP becomes an expert-parallel MoE layer when
+    the config asks for experts (reference moe/layer.py MoE drop-in).
+    Returns ``(x, l_aux)`` — l_aux is 0 for the dense path."""
+
     config: GPTConfig
 
     @nn.compact
@@ -121,10 +139,30 @@ class Block(nn.Module):
         x = x + CausalSelfAttention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x),
             mask=mask, deterministic=deterministic)
-        x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x),
-            deterministic=deterministic)
-        return x
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        if cfg.is_moe:
+            from deepspeed_tpu.moe.layer import MoE
+
+            y, l_aux, _ = MoE(
+                d_model=cfg.n_embd,
+                d_hidden=cfg.mlp_ratio * cfg.n_embd,
+                num_experts=cfg.moe_num_experts,
+                k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                min_capacity=cfg.moe_min_capacity,
+                noisy_gate_policy=cfg.moe_noisy_gate_policy,
+                drop_tokens=cfg.moe_drop_tokens,
+                use_rts=cfg.moe_use_rts,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                name="mlp",
+            )(h, deterministic=deterministic)
+        else:
+            y = MLP(cfg, name="mlp")(h, deterministic=deterministic)
+            l_aux = jnp.float32(0.0)
+        x = x + y
+        return x, l_aux
 
 
 class ScannedBlocks(nn.Module):
@@ -146,18 +184,18 @@ class ScannedBlocks(nn.Module):
 
         def body(block, carry):
             x, mask = carry
-            x = block(x, mask=mask, deterministic=deterministic)
-            return (x, mask), None
+            x, l_aux = block(x, mask=mask, deterministic=deterministic)
+            return (x, mask), l_aux
 
         scanned = nn.scan(
             body,
             variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
+            split_rngs={"params": True, "dropout": True, "gating": True},
             length=cfg.n_layer,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), _ = scanned(block_cls(cfg, name="block"), (x, mask))
-        return x
+        (x, _), l_aux = scanned(block_cls(cfg, name="block"), (x, mask))
+        return x, jnp.sum(l_aux)
 
 
 def gpt_tp_rules(path: str, shape) -> "PartitionSpec":
@@ -183,7 +221,10 @@ def gpt_tp_rules(path: str, shape) -> "PartitionSpec":
         return dim(-2)  # row parallel
     if path.endswith("wte/embedding"):
         return dim(0)   # vocab parallel (logits shard over vocab)
-    return None
+    # expert-parallel MoE params (ep axis + Megatron tp inside each expert)
+    from deepspeed_tpu.moe.layer import moe_param_spec
+
+    return moe_param_spec(path, shape)
 
 
 class GPT(nn.Module):
@@ -210,22 +251,29 @@ class GPT(nn.Module):
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         if cfg.scan_layers:
-            x = ScannedBlocks(cfg, name="h")(
+            x, l_aux = ScannedBlocks(cfg, name="h")(
                 x, mask=attention_mask, deterministic=deterministic)
         else:
+            l_aux = jnp.float32(0.0)
             for i in range(cfg.n_layer):
                 blk = Block
                 if cfg.remat:
                     blk = nn.remat(Block, prevent_cse=False)
-                x = blk(cfg, name=f"h_{i}")(
+                x, aux_i = blk(cfg, name=f"h_{i}")(
                     x, mask=attention_mask, deterministic=deterministic)
+                l_aux = l_aux + aux_i
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = wte.attend(x.astype(jnp.float32))
 
         if labels is None:
             return logits
-        return cross_entropy_loss(logits, labels, attention_mask)
+        loss = cross_entropy_loss(logits, labels, attention_mask)
+        if cfg.is_moe:
+            # load-balance aux loss, averaged over layers (reference adds the
+            # per-MoE-layer l_aux into the training loss with a coefficient)
+            loss = loss + cfg.moe_aux_loss_coef * l_aux / cfg.n_layer
+        return loss
 
 
 def cross_entropy_loss(logits, labels, mask=None):

@@ -1,0 +1,17 @@
+"""Mixture-of-Experts with expert parallelism (reference ``deepspeed/moe/``)."""
+
+from deepspeed_tpu.moe.layer import MoE, moe_param_spec  # noqa: F401
+from deepspeed_tpu.moe.experts import StackedExperts  # noqa: F401
+from deepspeed_tpu.moe.sharded_moe import (  # noqa: F401
+    GatingOutput,
+    combine_tokens,
+    dispatch_tokens,
+    static_capacity,
+    top1_gating,
+    top2_gating,
+    topk_gating,
+)
+from deepspeed_tpu.moe.utils import (  # noqa: F401
+    is_moe_param_path,
+    split_moe_params,
+)

@@ -1,0 +1,55 @@
+"""Stacked expert FFNs.
+
+Parity with reference ``deepspeed/moe/experts.py:9`` (Experts = ModuleList of
+cloned FFNs, each rank holding ``num_local_experts``). TPU re-design: ONE
+parameter tensor with a leading ``experts`` axis, sharded over the ``ep`` mesh
+axis — "local experts" are the shard XLA assigns this device; the per-expert
+loop becomes a batched einsum on the MXU.
+"""
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class StackedExperts(nn.Module):
+    """[E, C, M] -> [E, C, M] two-layer gelu FFN, vectorized over experts.
+
+    Param shapes carry the expert axis first (``wi: [E, M, H]``,
+    ``wo: [E, H, M]``) so expert-parallel sharding rules can address it
+    (see moe/layer.py moe_sharding_rules).
+    """
+
+    num_experts: int
+    d_model: int
+    d_hidden: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(),
+            (self.num_experts, self.d_model, self.d_hidden), self.param_dtype,
+        )
+        bi = self.param(
+            "bi", nn.initializers.zeros,
+            (self.num_experts, self.d_hidden), self.param_dtype,
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(),
+            (self.num_experts, self.d_hidden, self.d_model), self.param_dtype,
+        )
+        bo = self.param(
+            "bo", nn.initializers.zeros,
+            (self.num_experts, self.d_model), self.param_dtype,
+        )
+        x = x.astype(self.dtype)
+        h = jnp.einsum("ecm,emh->ech", x, wi.astype(self.dtype))
+        h = h + bi[:, None, :].astype(self.dtype)
+        h = self.activation(h)
+        y = jnp.einsum("ech,ehm->ecm", h, wo.astype(self.dtype))
+        y = y + bo[:, None, :].astype(self.dtype)
+        return y

@@ -1,0 +1,131 @@
+"""The MoE layer.
+
+Parity with reference ``deepspeed/moe/layer.py:15`` (MoE = TopKGate +
+MOELayer + Experts with expert-parallel all-to-all) re-designed for SPMD:
+
+* gate: small fp32 Dense (reference TopKGate wg, sharded_moe.py:351)
+* dispatch: einsum to ``[experts, capacity, model]`` + a PartitionSpec("ep")
+  sharding constraint — GSPMD emits the all-to-all the reference implements
+  as the ``_AllToAll`` autograd function (sharded_moe.py:89)
+* experts: one stacked tensor sharded over ``ep`` (moe/experts.py)
+* expert vs non-expert gradient groups (reference engine.py:2225-2287) need
+  no special handling: the global-view jit program reduces each param over
+  exactly the axes it is replicated on.
+
+The layer returns ``(y, l_aux, exp_counts)``; the model adds
+``aux_coef * l_aux`` to its loss (reference stores l_aux on the module and
+the engine collects it).
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.moe.experts import StackedExperts
+from deepspeed_tpu.moe.sharded_moe import (
+    combine_tokens,
+    dispatch_tokens,
+    topk_gating,
+)
+
+
+def _ep_constraint(x, ndim_spec):
+    """Sharding constraint over the ep axis; a no-op when no ep axis is
+    active in the default topology."""
+    from deepspeed_tpu.parallel.mesh import get_default_topology
+
+    topo = get_default_topology()
+    if topo.size("ep") <= 1:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(topo.mesh, PartitionSpec(*ndim_spec))
+    )
+
+
+class MoE(nn.Module):
+    """Drop-in FFN replacement (reference moe/layer.py:15 wraps an `expert`
+    module; here the expert FFN is built from d_model/d_hidden)."""
+
+    d_model: int
+    d_hidden: int
+    num_experts: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        orig_shape = x.shape
+        d_model = orig_shape[-1]
+        tokens = x.reshape(-1, d_model)
+
+        # gate in fp32 (reference TopKGate casts input to float, wg fp32)
+        gate_logits = nn.Dense(
+            self.num_experts, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="gate",
+        )(tokens.astype(jnp.float32))
+
+        rng = None
+        if not deterministic and self.has_rng("gating"):
+            rng = self.make_rng("gating")
+
+        gout = topk_gating(
+            gate_logits,
+            k=self.k,
+            capacity_factor=(self.capacity_factor if not deterministic
+                             else self.eval_capacity_factor),
+            min_capacity=self.min_capacity,
+            rng=rng,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens,
+            use_rts=self.use_rts,
+        )
+
+        dispatched = dispatch_tokens(gout.dispatch_mask, tokens)  # [E,C,M]
+        dispatched = _ep_constraint(dispatched, ("ep", None, None))
+        expert_out = StackedExperts(
+            num_experts=self.num_experts,
+            d_model=self.d_model,
+            d_hidden=self.d_hidden,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="experts",
+        )(dispatched)
+        expert_out = _ep_constraint(expert_out, ("ep", None, None))
+        y = combine_tokens(gout.combine_weights, expert_out, dtype=x.dtype)
+        return y.reshape(orig_shape), gout.l_aux, gout.exp_counts
+
+
+def moe_param_spec(path: str, shape) -> Optional[PartitionSpec]:
+    """Expert-parallel PartitionSpec for MoE params, composable with TP rules.
+
+    Expert tensors carry the expert axis 3rd-from-last (wi/wo) or 2nd-from-
+    last (bi/bo) — robust to a leading scan-layer axis. Column-parallel tp on
+    wi's hidden dim, row-parallel on wo's hidden dim (Megatron FFN pattern).
+    """
+    ndim = len(shape)
+
+    def spec(**axis_by_dim):
+        s = [None] * ndim
+        for d, a in axis_by_dim.items():
+            s[int(d)] = a
+        return PartitionSpec(*s)
+
+    if "experts/" not in path:
+        return None
+    if path.endswith("experts/wi"):
+        return spec(**{str(ndim - 3): "ep", str(ndim - 1): "tp"})
+    if path.endswith("experts/wo"):
+        return spec(**{str(ndim - 3): "ep", str(ndim - 2): "tp"})
+    if path.endswith(("experts/bi", "experts/bo")):
+        return spec(**{str(ndim - 2): "ep"})
+    return None

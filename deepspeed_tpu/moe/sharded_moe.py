@@ -1,0 +1,221 @@
+"""Top-k gating + dispatch/combine math for Mixture-of-Experts.
+
+Capability parity with reference ``deepspeed/moe/sharded_moe.py`` (top1gating
+:177, top2gating :278, MOELayer :439): softmax gating with static capacity,
+load-balancing auxiliary loss, random token selection (RTS), gumbel-noise
+second-expert choice, einsum dispatch/combine.
+
+TPU re-design notes:
+
+* Capacity is computed at TRACE time from the static token count — XLA needs
+  static shapes, and the reference's ``drop_tokens=False`` dynamic capacity
+  (all-reduced max) becomes "capacity = all tokens" here (worst case, static).
+* The reference's ``_AllToAll`` autograd function + expert process groups
+  collapse into a sharding constraint: dispatched tensors are laid out
+  ``[experts, capacity, model]`` and annotated with PartitionSpec("ep", ...);
+  GSPMD inserts the all-to-all (and its transpose in the backward) itself.
+* Everything is differentiable exactly where the reference is: gradients flow
+  through the gate probabilities in combine_weights and through l_aux; the
+  argmax/top-k index paths are non-differentiable in both.
+"""
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GatingOutput(NamedTuple):
+    l_aux: jnp.ndarray           # scalar load-balance loss
+    combine_weights: jnp.ndarray  # [tokens, experts, capacity] float
+    dispatch_mask: jnp.ndarray    # [tokens, experts, capacity] bool
+    exp_counts: jnp.ndarray       # [experts] int32 — tokens routed per expert
+
+
+def static_capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+                    min_capacity: int) -> int:
+    """Static per-expert capacity (reference sharded_moe.py:155 _capacity).
+
+    Python math on static shapes so the jitted program has fixed buffers.
+    """
+    capacity = int(np.ceil((num_tokens / num_experts) * capacity_factor))
+    capacity = max(capacity, min_capacity)
+    return min(capacity, num_tokens)
+
+
+def _gumbel(rng, shape):
+    return jax.random.gumbel(rng, shape, dtype=jnp.float32)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.int32)
+
+
+def top1_gating(
+    logits: jnp.ndarray,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    rng: Optional[jax.Array] = None,
+    noisy_gate_policy: Optional[str] = None,
+    drop_tokens: bool = True,
+    use_rts: bool = True,
+    used_token: Optional[jnp.ndarray] = None,
+) -> GatingOutput:
+    """Top-1 (Switch) gating (reference sharded_moe.py:177).
+
+    ``rng`` drives RSample noise and random-token-selection; pass None for
+    deterministic eval (noise and RTS are skipped, matching the reference's
+    behaviour when no stochastic path is active).
+    """
+    logits = logits.astype(jnp.float32)
+    num_tokens, num_experts = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    if drop_tokens:
+        capacity = static_capacity(num_tokens, num_experts, capacity_factor,
+                                   min_capacity)
+    else:
+        capacity = num_tokens  # static worst case (reference all-reduces a max)
+
+    if noisy_gate_policy == "RSample" and rng is not None:
+        rng, sub = jax.random.split(rng)
+        indices1 = jnp.argmax(logits + _gumbel(sub, logits.shape), axis=-1)
+    else:
+        indices1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(indices1, num_experts)
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None].astype(mask1.dtype)
+
+    exp_counts = jnp.sum(mask1, axis=0).astype(jnp.int32)
+
+    # load-balance loss (reference :218): mean(gate_prob) . mean(assignment)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * num_experts
+
+    if use_rts and rng is not None:
+        # Random Token Selection (reference :227): random priority per routed
+        # token, keep the top-`capacity` per expert
+        rng, sub = jax.random.split(rng)
+        priority = mask1.astype(jnp.float32) * jax.random.uniform(
+            sub, mask1.shape, dtype=jnp.float32
+        )
+        _, top_idx = jax.lax.top_k(priority.T, capacity)  # [E, C] token ids
+        keep = jnp.zeros((num_experts, num_tokens), jnp.int32)
+        keep = jax.vmap(lambda row, idx: row.at[idx].set(1))(keep, top_idx)
+        mask1 = mask1 * keep.T
+        locations1 = jnp.cumsum(mask1, axis=0) - 1
+    else:
+        # deterministic: first-come-first-served by position (stable top-k)
+        locations1 = jnp.cumsum(mask1, axis=0) - 1
+        mask1 = mask1 * (locations1 < capacity).astype(mask1.dtype)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=-1)
+
+    gates = gates * mask1.astype(jnp.float32)
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=jnp.float32)
+    combine = jnp.einsum("te,tc->tec", gates, locations1_sc)
+    # zero out dropped tokens' capacity rows (one_hot(0) would alias slot 0)
+    combine = combine * mask1[..., None].astype(jnp.float32)
+    dispatch = combine > 0
+    return GatingOutput(l_aux, combine, dispatch, exp_counts)
+
+
+def top2_gating(
+    logits: jnp.ndarray,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    rng: Optional[jax.Array] = None,
+) -> GatingOutput:
+    """Top-2 (GShard) gating (reference sharded_moe.py:278): second expert via
+    gumbel-max over the non-top logits, combined weights renormalized over the
+    two selected experts."""
+    logits = logits.astype(jnp.float32)
+    num_tokens, num_experts = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    capacity = static_capacity(num_tokens, num_experts, 2.0 * capacity_factor,
+                               min_capacity)
+
+    indices1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(indices1, num_experts)
+
+    if rng is not None:
+        logits_w_noise = logits + _gumbel(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    logits_except1 = jnp.where(mask1.astype(bool), -jnp.inf, logits_w_noise)
+    indices2 = jnp.argmax(logits_except1, axis=-1)
+    mask2 = _one_hot(indices2, num_experts)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations2 = jnp.cumsum(mask2, axis=0) - 1
+    locations2 = locations2 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    exp_counts = jnp.sum(mask1, axis=0).astype(jnp.int32)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.mean(me * ce) * num_experts * num_experts
+
+    mask1 = mask1 * (locations1 < capacity).astype(mask1.dtype)
+    mask2 = mask2 * (locations2 < capacity).astype(mask2.dtype)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=-1)
+    locations2_s = jnp.sum(locations2 * mask2, axis=-1)
+
+    mask1_f = mask1.astype(jnp.float32)
+    mask2_f = mask2.astype(jnp.float32)
+    gates1_s = jnp.einsum("te,te->t", gates, mask1_f)
+    gates2_s = jnp.einsum("te,te->t", gates, mask2_f)
+    denom = jnp.maximum(gates1_s + gates2_s, jnp.finfo(jnp.float32).eps)
+    gates1_s = gates1_s / denom
+    gates2_s = gates2_s / denom
+
+    gates1 = jnp.einsum("t,te->te", gates1_s, mask1_f)
+    gates2 = jnp.einsum("t,te->te", gates2_s, mask2_f)
+    loc1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=jnp.float32)
+    loc2_sc = jax.nn.one_hot(locations2_s, capacity, dtype=jnp.float32)
+    combine = (
+        jnp.einsum("te,tc->tec", gates1, loc1_sc) * mask1_f[..., None]
+        + jnp.einsum("te,tc->tec", gates2, loc2_sc) * mask2_f[..., None]
+    )
+    dispatch = combine > 0
+    return GatingOutput(l_aux, combine, dispatch, exp_counts)
+
+
+def topk_gating(logits, k: int, **kwargs) -> GatingOutput:
+    if k == 1:
+        return top1_gating(logits, **kwargs)
+    if k == 2:
+        # these knobs only exist on the top-1 path (as in the reference, where
+        # top2gating takes no noise/RTS/drop arguments) — reject non-defaults
+        # rather than silently changing routing behaviour
+        unsupported = {
+            "noisy_gate_policy": None, "drop_tokens": True,
+            "use_rts": True, "used_token": None,
+        }
+        for name, default in unsupported.items():
+            if name in kwargs and kwargs.pop(name) != default:
+                raise ValueError(
+                    f"top-2 gating does not support {name} "
+                    "(top-1-only option, see reference sharded_moe.py:278)"
+                )
+        return top2_gating(logits, **kwargs)
+    raise ValueError(f"only top-1 and top-2 gating are supported, got k={k}")
+
+
+def dispatch_tokens(dispatch_mask: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """[T,E,C] bool x [T,M] -> [E,C,M] (reference MOELayer einsum "sec,sm->ecm",
+    sharded_moe.py:439 forward). MXU-friendly: a single batched matmul."""
+    return jnp.einsum("tec,tm->ecm", dispatch_mask.astype(x.dtype), x)
+
+
+def combine_tokens(combine_weights: jnp.ndarray, expert_out: jnp.ndarray,
+                   dtype=None) -> jnp.ndarray:
+    """[T,E,C] x [E,C,M] -> [T,M] (reference einsum "sec,ecm->sm")."""
+    y = jnp.einsum(
+        "tec,ecm->tm", combine_weights,
+        expert_out.astype(combine_weights.dtype),
+    )
+    return y.astype(dtype) if dtype is not None else y

@@ -1,0 +1,32 @@
+"""MoE param utilities (reference ``deepspeed/moe/utils.py``:
+is_moe_param, split_params_into_different_moe_groups_for_optimizer).
+
+In the pytree world a param is identified by its path, so the expert/
+non-expert split is a path predicate instead of a tensor attribute."""
+
+from typing import Any, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.tree import path_str
+
+
+def is_moe_param_path(path: str) -> bool:
+    """True for expert-parallel params (sharded over ep, NOT reduced over it)."""
+    return "experts/" in path or path.endswith("/experts")
+
+
+def split_moe_params(params) -> Tuple[Any, Any]:
+    """Partition a param pytree into (expert, non-expert) trees with None at
+    the complementary leaves (reference splits torch param_groups)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    paths = [path_str(path) for path, _ in flat]
+
+    def select(moe: bool):
+        leaves = [
+            leaf if is_moe_param_path(path) == moe else None
+            for path, (_, leaf) in zip(paths, flat)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    return select(True), select(False)

@@ -337,7 +337,8 @@ class DeepSpeedEngine:
             def loss_fn(p):
                 loss = model.apply(
                     {"params": p}, **batch, deterministic=False,
-                    rngs={"dropout": rng},
+                    rngs={"dropout": rng,
+                          "gating": jax.random.fold_in(rng, 7)},
                 )
                 # loss scaled by 1/gas (reference engine.py:1789 -> :1596)
                 # and by the fp16 loss scale (loss_scaler.py)

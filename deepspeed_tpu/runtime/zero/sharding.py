@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from deepspeed_tpu.parallel.mesh import MeshTopology, shard_largest_dim_spec
+from deepspeed_tpu.utils.tree import path_str as _path_str
 
 
 def _spec_for_shape(shape, topo: MeshTopology, min_size: int = 0,
@@ -171,15 +172,3 @@ def _tree_map_with_path(fn, tree):
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: fn(_path_str(path), leaf), tree
     )
-
-
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        else:
-            parts.append(str(p))
-    return "/".join(parts)

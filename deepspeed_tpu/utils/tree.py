@@ -1,0 +1,25 @@
+"""Pytree path utilities shared across subsystems (params are addressed by
+path string for sharding rules, MoE grouping, checkpoint reshaping)."""
+
+from typing import Any, Dict
+
+import jax
+
+
+def key_str(entry) -> str:
+    """One path entry -> string (handles DictKey/GetAttrKey/SequenceKey)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def path_str(path) -> str:
+    """Full pytree key path -> 'a/b/c'."""
+    return "/".join(key_str(p) for p in path)
+
+
+def flatten_with_paths(tree) -> Dict[str, Any]:
+    """Pytree -> {path_string: leaf}."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {path_str(path): leaf for path, leaf in flat}
