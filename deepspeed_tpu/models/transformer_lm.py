@@ -90,7 +90,12 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
 
-        if cfg.use_flash_attention and x.shape[1] % 128 == 0:
+        # flash path needs 128-aligned seq (TPU tile constraint), no padding
+        # mask, and no attention dropout (the kernel has none)
+        use_flash = (cfg.use_flash_attention and mask is None
+                     and T % 128 == 0
+                     and (cfg.dropout == 0.0 or deterministic))
+        if use_flash:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
             y = flash_attention(q, k, v, causal=True)

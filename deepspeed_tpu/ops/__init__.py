@@ -1,0 +1,22 @@
+"""Op library (reference ``deepspeed/ops/`` + ``csrc/``, SURVEY.md §2.4).
+
+Compute-path kernels are Pallas (ops/pallas); elementwise/grouped ops that
+XLA already fuses optimally are pure jnp with the reference's API surface.
+"""
+
+from deepspeed_tpu.ops.quantizer import (  # noqa: F401
+    dequantize,
+    fake_quantize,
+    int8_matmul,
+    quantize,
+)
+from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rotary_angles  # noqa: F401
+
+
+def __getattr__(name):
+    # pallas kernels imported lazily (pallas import is heavier)
+    if name in ("flash_attention", "fused_adamw", "fused_adamw_update"):
+        from deepspeed_tpu.ops import pallas as _p
+
+        return getattr(_p, name)
+    raise AttributeError(name)

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the native-kernel layer (reference ``csrc/`` CUDA,
+SURVEY.md §2.4). Kernels run compiled on TPU and in interpreter mode on the
+CPU test mesh."""
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
+from deepspeed_tpu.ops.pallas.fused_adam import (  # noqa: F401
+    fused_adamw,
+    fused_adamw_update,
+)

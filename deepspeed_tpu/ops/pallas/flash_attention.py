@@ -1,0 +1,305 @@
+"""Flash attention as Pallas TPU kernels.
+
+The TPU equivalent of the reference's fused attention CUDA kernels
+(``csrc/transformer/softmax_kernels.cu``, triton ``triton_flash_attn``,
+``ops/transformer/inference/triton_ops.py:103``): blockwise online-softmax
+attention that never materializes the [T, T] score matrix in HBM.
+
+Layout: q/k/v are ``[batch, seq, heads, head_dim]`` (the model's natural
+layout). The kernel grid is (batch*heads, q_blocks); each program streams K/V
+blocks from VMEM with running max/sum rescaling. The backward pass is the
+standard two-kernel recompute formulation (dq; then dk/dv) using the saved
+logsumexp — O(T) memory like the forward.
+
+On non-TPU backends the kernels run in Pallas interpreter mode, so the CPU
+test mesh exercises the exact same code path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+LSE_LANES = 8  # sublane-padded copies for TPU tile constraints
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(t: int, want: int = 128) -> int:
+    """Largest block size <= want dividing t."""
+    b = min(want, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k):
+    bq, d = q_ref.shape
+    t = k_ref.shape[0]
+    nk = t // block_k
+    qi = pl.program_id(1)
+
+    q = q_ref[...].astype(jnp.float32) * scale  # [bq, d]
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, block_k]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only blocks with k_start <= q_end contribute
+        nk_eff = jnp.minimum((qi * bq + bq + block_k - 1) // block_k, nk)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m, l, acc))
+
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    # lse carries 8 broadcast sublane copies to satisfy TPU tiling
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), (bq, LSE_LANES))
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    b, t, h, d = q.shape
+    bh = b * h
+    qf = q.transpose(0, 2, 1, 3).reshape(bh, t, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(bh, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(bh, t, d)
+    nq = t // block_q
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, LSE_LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (recompute with saved lse)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k):
+    bq, d = q_ref.shape
+    t = k_ref.shape[0]
+    nk = t // block_k
+    qi = pl.program_id(1)
+
+    q = q_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, :1]
+    delta = delta_ref[...][:, :1]
+    dq = jnp.zeros((bq, d), jnp.float32)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, dq):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + scale * jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    nk_eff = (jnp.minimum((qi * bq + bq + block_k - 1) // block_k, nk)
+              if causal else nk)
+    dq = jax.lax.fori_loop(0, nk_eff, body, dq)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q):
+    bk, d = k_ref.shape
+    t = q_ref.shape[0]
+    nq = t // block_q
+    ki = pl.program_id(1)
+
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        j = i + (ki * bk) // block_q if causal else i
+        q_blk = q_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[pl.ds(j * block_q, block_q), :1]
+        delta_blk = delta_ref[pl.ds(j * block_q, block_q), :1]
+        s = scale * jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, bk]
+        if causal:
+            q_pos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q blocks entirely before this k block's diagonal contribute nothing
+        n_eff = nq - (ki * bk) // block_q
+    else:
+        n_eff = nq
+    dk, dv = jax.lax.fori_loop(0, n_eff, body, (dk, dv))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    do = g
+    b, t, h, d = q.shape
+    bh = b * h
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
+
+    qf, kf, vf = map(flat, (q, k, v))
+    of, dof = o, do  # already [bh, t, d] (the op's internal layout)
+    delta = jnp.sum(of.astype(jnp.float32) * dof.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LSE_LANES,))
+
+    nq, nk = t // block_q, t // block_k
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(bh, nk),
+        in_specs=[
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    def unflat(x):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Blockwise attention over ``[batch, seq, heads, head_dim]`` inputs.
+
+    Memory is O(seq) per program instead of O(seq^2); the [T, T] score matrix
+    only ever exists one [block_q, block_k] tile at a time in VMEM.
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    block_q = _block(t, block_q)
+    block_k = _block(t, block_k)
+    of = _flash(q, k, v, float(scale), bool(causal), block_q, block_k)
+    return of.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,114 @@
+"""Quantization ops.
+
+Parity with reference ``csrc/quantization/quantizer.cu`` via
+``ops/quantizer/quantizer.py:27`` (``ds_quantize_fp32/fp16``, stochastic-
+rounding ``ds_sr_quantize_*`` and asymmetric ``*_asym`` variants): grouped
+symmetric/asymmetric fake-quantization and int8 extraction.
+
+These are elementwise + per-group reductions — exactly what XLA fuses into
+single VPU passes, so the implementation is pure jnp (a Pallas kernel would
+re-derive the same schedule). Stochastic rounding uses jax PRNG keys instead
+of the CUDA Philox state.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    n = x.size
+    assert n % num_groups == 0, (
+        f"size {n} not divisible into {num_groups} groups")
+    return x.reshape(num_groups, n // num_groups)
+
+
+def quantize(
+    x: jnp.ndarray,
+    num_bits: int = 8,
+    num_groups: int = 1,
+    symmetric: bool = True,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
+    """Quantize to ``(q_int, scale, zero_point)`` with per-group scales.
+
+    Symmetric: q = round(x/scale), scale = max|x| / qmax (reference
+    ds_quantize). Asymmetric: affine with zero point (reference *_asym).
+    ``stochastic`` adds uniform noise in [-0.5, 0.5) before rounding
+    (reference ds_sr_quantize stochastic rounding).
+    """
+    orig_shape = x.shape
+    g = _grouped(x.astype(jnp.float32), num_groups)
+    qmax = float(2 ** (num_bits - 1) - 1)
+
+    if symmetric:
+        scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-12)
+        scaled = g / scale
+        zero_point = None
+    else:
+        lo = jnp.min(g, axis=-1, keepdims=True)
+        hi = jnp.max(g, axis=-1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / (2 ** num_bits - 1), 1e-12)
+        zero_point = lo
+        scaled = (g - lo) / scale - qmax - 1
+
+    if stochastic:
+        assert rng is not None, "stochastic rounding needs an rng key"
+        noise = jax.random.uniform(rng, scaled.shape, minval=-0.5, maxval=0.5)
+        q = jnp.floor(scaled + 0.5 + noise)
+    else:
+        q = jnp.round(scaled)
+    q = jnp.clip(q, -qmax - 1, qmax).astype(jnp.int8 if num_bits <= 8
+                                            else jnp.int32)
+    q = q.reshape(orig_shape)
+    return q, scale[:, 0], (zero_point[:, 0] if zero_point is not None
+                            else None)
+
+
+def dequantize(
+    q: jnp.ndarray,
+    scale: jnp.ndarray,
+    zero_point: Optional[jnp.ndarray] = None,
+    num_bits: int = 8,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Inverse of :func:`quantize` (reference dequantize.cu)."""
+    orig_shape = q.shape
+    num_groups = scale.shape[0]
+    g = _grouped(q.astype(jnp.float32), num_groups)
+    qmax = float(2 ** (num_bits - 1) - 1)
+    if zero_point is None:
+        out = g * scale[:, None]
+    else:
+        out = (g + qmax + 1) * scale[:, None] + zero_point[:, None]
+    return out.reshape(orig_shape).astype(dtype)
+
+
+def fake_quantize(x, num_bits=8, num_groups=1, symmetric=True,
+                  stochastic=False, rng=None):
+    """Quantize-dequantize round trip in the input dtype (what MoQ applies to
+    weights during training, reference runtime/quantize.py)."""
+    q, scale, zp = quantize(x, num_bits, num_groups, symmetric, stochastic,
+                            rng)
+    return dequantize(q, scale, zp, num_bits, dtype=x.dtype)
+
+
+def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
+                preferred_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Matmul against a per-column-group int8 weight (inference int8 path,
+    reference pt_binding int8 GEMM variants): dequantize rides the MXU
+    epilogue via scale multiply after an int8->bf16 cast."""
+    w = w_q.astype(preferred_dtype)
+    y = jnp.dot(x.astype(preferred_dtype), w,
+                preferred_element_type=jnp.float32)
+    if not (w_scale.ndim == 1 and w_scale.shape[0] == w_q.shape[-1]):
+        raise ValueError(
+            "int8_matmul needs per-output-column scales: w_scale shape "
+            f"{w_scale.shape} does not match weight columns {w_q.shape[-1]} "
+            "(quantize the weight with num_groups == out_features)"
+        )
+    y = y * w_scale[None, :]
+    return y.astype(preferred_dtype)

@@ -1,0 +1,46 @@
+"""Rotary position embeddings.
+
+Parity with reference ``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``
+(exposed as ``apply_rotary_pos_emb`` in pt_binding.cpp): rotate q/k pairs by
+position-dependent angles. Pure jnp — XLA fuses the sin/cos/interleave into
+the surrounding attention matmuls; the CUDA kernel exists because torch
+eager could not.
+"""
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rotary_angles(positions: jnp.ndarray, dim: int, base: float = 10000.0,
+                  dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables of shape [..., dim/2] for integer positions."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary_pos_emb(
+    x: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,
+    base: float = 10000.0,
+    rotary_dim: Optional[int] = None,
+) -> jnp.ndarray:
+    """Rotate ``x: [batch, seq, heads, head_dim]`` (pairwise half-dim split,
+    the GPT-NeoX convention the reference's kernel implements with
+    rotate_half)."""
+    b, t, h, d = x.shape
+    rd = rotary_dim or d
+    if positions is None:
+        positions = jnp.arange(t)[None, :]
+    cos, sin = rotary_angles(positions, rd, base, dtype=x.dtype)
+    cos = cos[:, :, None, :]  # [b, t, 1, rd/2]
+    sin = sin[:, :, None, :]
+
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    x1, x2 = x_rot[..., : rd // 2], x_rot[..., rd // 2:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if rd < d:
+        return jnp.concatenate([rotated, x_pass], axis=-1)
+    return rotated

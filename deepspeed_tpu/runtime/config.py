@@ -280,6 +280,9 @@ class TpuConfig(ConfigModel):
     remat: str = "none"  # none | full | selective (dots_saveable)
     donate_params: bool = True
     matmul_precision: str = "default"
+    # route FusedAdam to the Pallas kernel (ops/pallas/fused_adam.py) instead
+    # of optax's XLA-fused chain
+    use_pallas_optimizer: bool = False
 
     @property
     def mesh_config(self) -> MeshConfig:

@@ -280,7 +280,10 @@ class DeepSpeedEngine:
                 "reference's torch.optim objects have no TPU meaning"
             )
         lr = self._schedule_fn  # None -> use params lr
-        return build_optimizer(cfg.optimizer.type, cfg.optimizer.params, lr)
+        return build_optimizer(
+            cfg.optimizer.type, cfg.optimizer.params, lr,
+            use_pallas=cfg.tpu.use_pallas_optimizer,
+        )
 
     def _configure_monitor(self):
         try:

@@ -28,11 +28,13 @@ def build_optimizer(
     opt_type: Optional[str],
     opt_params: Optional[Dict[str, Any]] = None,
     learning_rate: Union[float, Callable, None] = None,
+    use_pallas: bool = False,
 ) -> optax.GradientTransformation:
     """Map a DeepSpeed optimizer block to an optax transformation.
 
     ``learning_rate`` may be a float or a trace-safe schedule fn; when None,
-    the lr from the params block is used.
+    the lr from the params block is used. ``use_pallas`` routes FusedAdam to
+    the single-pass Pallas kernel.
     """
     opt_params = dict(opt_params or {})
     lr = learning_rate if learning_rate is not None else opt_params.get("lr", 1e-3)
@@ -41,6 +43,15 @@ def build_optimizer(
     wd = float(opt_params.get("weight_decay", 0.0))
 
     name = (opt_type or C.ADAMW_OPTIMIZER).lower()
+
+    # the Pallas kernel implements decoupled (AdamW) decay only; coupled-L2
+    # Adam (adam_w_mode=False) falls through to the optax path
+    if use_pallas and name in (C.ADAM_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER,
+                               C.ADAMW_OPTIMIZER) and bool(
+                                   opt_params.get("adam_w_mode", True)):
+        from deepspeed_tpu.ops.pallas.fused_adam import fused_adamw
+
+        return fused_adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=wd)
 
     if name in (C.ADAM_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER):
         # reference FusedAdam defaults to adam_w_mode=True (ops/adam/fused_adam.py:15)

@@ -1,0 +1,204 @@
+"""Op-level tests vs pure-JAX references
+(reference tests/unit/ops/ kernel-vs-torch comparisons)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import (
+    apply_rotary_pos_emb,
+    dequantize,
+    fake_quantize,
+    quantize,
+)
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas.fused_adam import (
+    fused_adamw,
+    fused_adamw_update,
+)
+
+
+def _ref_attention(q, k, v, causal=True):
+    b, t, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("t", [64, 128])
+    def test_forward_matches_reference(self, causal, t):
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        shape = (2, t, 4, 32)
+        q = jax.random.normal(kq, shape, jnp.float32)
+        k = jax.random.normal(kk, shape, jnp.float32)
+        v = jax.random.normal(kv, shape, jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        ref = _ref_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_reference(self, causal):
+        rng = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(rng, 3)
+        shape = (1, 64, 2, 16)
+        q = jax.random.normal(kq, shape, jnp.float32)
+        k = jax.random.normal(kk, shape, jnp.float32)
+        v = jax.random.normal(kv, shape, jnp.float32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=causal,
+                                block_q=32, block_k=32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_ref_attention(q, k, v, causal=causal) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=1e-3,
+                err_msg=f"d{name} mismatch")
+
+    def test_bf16_runs(self):
+        rng = jax.random.PRNGKey(2)
+        shape = (2, 128, 4, 32)
+        q = jax.random.normal(rng, shape, jnp.bfloat16)
+        out = flash_attention(q, q, q, causal=True)
+        assert out.dtype == jnp.bfloat16
+        assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+class TestFusedAdam:
+    def test_single_update_matches_optax(self):
+        rng = jax.random.PRNGKey(0)
+        p = jax.random.normal(rng, (130, 7))  # deliberately unaligned
+        g = jax.random.normal(jax.random.fold_in(rng, 1), (130, 7))
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        lr, wd = 1e-2, 0.1
+        pn, mn, vn = fused_adamw_update(p, g, m, v, lr, 1.0, weight_decay=wd)
+
+        tx = __import__("optax").adamw(lr, weight_decay=wd)
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        p_ref = p + updates
+        np.testing.assert_allclose(np.asarray(pn), np.asarray(p_ref),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_schedule_evaluated_at_optax_convention(self):
+        """First update must see fn(0), like optax (not fn(1))."""
+        import optax
+
+        sched = lambda c: 0.1 * c  # noqa: E731 — lr 0 at step 0
+        params = {"w": jnp.ones((8, 8))}
+        grads = {"w": jnp.ones((8, 8))}
+        tx = fused_adamw(sched)
+        ref = optax.adamw(sched, weight_decay=0.0)
+        s, rs = tx.init(params), ref.init(params)
+        p1, p2 = params, params
+        for _ in range(2):
+            u1, s = tx.update(grads, s, p1)
+            p1 = optax.apply_updates(p1, u1)
+            u2, rs = ref.update(grads, rs, p2)
+            p2 = optax.apply_updates(p2, u2)
+        np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                                   atol=1e-6)
+
+    def test_transformation_multi_step(self):
+        import optax
+
+        params = {"a": jnp.ones((64, 64)), "b": jnp.ones((5,))}
+        grads = jax.tree.map(lambda x: 0.1 * jnp.ones_like(x), params)
+        tx = fused_adamw(1e-3, weight_decay=0.01)
+        ref = optax.adamw(1e-3, weight_decay=0.01)
+        s, rs = tx.init(params), ref.init(params)
+        p1, p2 = params, params
+        for _ in range(3):
+            u1, s = tx.update(grads, s, p1)
+            p1 = optax.apply_updates(p1, u1)
+            u2, rs = ref.update(grads, rs, p2)
+            p2 = optax.apply_updates(p2, u2)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                       atol=1e-6, rtol=1e-5)
+
+
+class TestQuantizer:
+    def test_symmetric_roundtrip_error_bounded(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 256))
+        q, scale, zp = quantize(x, num_bits=8, num_groups=4)
+        assert q.dtype == jnp.int8
+        assert zp is None
+        back = dequantize(q, scale, num_bits=8)
+        max_per_group = np.abs(np.asarray(x).reshape(4, -1)).max(1)
+        step = max_per_group / 127.0
+        err = np.abs(np.asarray(back - x)).reshape(4, -1).max(1)
+        assert (err <= step * 0.51 + 1e-7).all()
+
+    def test_asymmetric_roundtrip(self):
+        x = jax.random.uniform(jax.random.PRNGKey(1), (2, 128),
+                               minval=3.0, maxval=5.0)
+        q, scale, zp = quantize(x, num_bits=8, num_groups=2, symmetric=False)
+        back = dequantize(q, scale, zp, num_bits=8)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=0.02)
+
+    def test_stochastic_rounding_unbiased(self):
+        x = jnp.full((1, 512), 0.3)
+        q, scale, _ = quantize(x, num_bits=4, num_groups=1)
+        outs = []
+        for i in range(64):
+            outs.append(np.asarray(dequantize(*quantize(
+                x, num_bits=4, num_groups=1, stochastic=True,
+                rng=jax.random.PRNGKey(i))[:2], num_bits=4)).mean())
+        # the mean over stochastic draws approaches x much closer than one
+        # deterministic rounding step
+        assert abs(np.mean(outs) - 0.3) < 0.01
+
+    def test_fake_quantize_shape_dtype(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 32), jnp.bfloat16)
+        y = fake_quantize(x, num_bits=8, num_groups=8)
+        assert y.shape == x.shape and y.dtype == x.dtype
+
+
+class TestRotary:
+    def test_norm_preserved(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 32))
+        y = apply_rotary_pos_emb(x)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5)
+
+    def test_position_zero_identity(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 4, 2, 16))
+        y = apply_rotary_pos_emb(x, positions=jnp.zeros((1, 4), jnp.int32))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+
+    def test_relative_property(self):
+        """<rot(q, m), rot(k, n)> depends only on m - n."""
+        d = 16
+        q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, d))
+        k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, d))
+
+        def dot_at(m, n):
+            qm = apply_rotary_pos_emb(q, jnp.array([[m]]))
+            kn = apply_rotary_pos_emb(k, jnp.array([[n]]))
+            return float(jnp.sum(qm * kn))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+        assert dot_at(7, 7) == pytest.approx(dot_at(0, 0), rel=1e-4)
+
+    def test_partial_rotary_dim(self):
+        x = jax.random.normal(jax.random.PRNGKey(4), (1, 8, 2, 32))
+        y = apply_rotary_pos_emb(x, rotary_dim=16)
+        np.testing.assert_array_equal(np.asarray(y[..., 16:]),
+                                      np.asarray(x[..., 16:]))
