@@ -1,0 +1,79 @@
+"""GPT expressed as a pipeline layer list.
+
+Parity with the reference's Megatron-GPT2 pipeline examples (layers =
+embedding, N transformer blocks, final norm + head; reference
+pipe/module.py consumers): each layer maps hidden -> hidden so the
+PipelineEngine can cut the list at any boundary.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer_lm import (
+    Block,
+    GPTConfig,
+    cross_entropy_loss,
+)
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+
+class GPTEmbed(nn.Module):
+    """input_ids -> hidden."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wpe")
+        x = wte(input_ids) + wpe(jnp.arange(T)[None, :])
+        return nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+
+class GPTPipeBlock(nn.Module):
+    """hidden -> hidden (drops the MoE aux loss — pipeline GPT is dense;
+    reference pipeline examples are dense too)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        x, _ = Block(self.config, name="block")(
+            x, deterministic=deterministic)
+        return x
+
+
+class GPTHead(nn.Module):
+    """hidden -> logits (untied unembedding; the tied variant is expressed
+    with TiedLayerSpec over GPTEmbed/GPTHead sharing 'embed')."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, name="lm_head")(
+            x.astype(jnp.float32))
+
+
+def gpt_pipeline(config: GPTConfig, num_stages: Optional[int] = None,
+                 partition_method: str = "uniform") -> PipelineModule:
+    """LayerSpec list for a GPT LM + next-token loss."""
+    assert not config.is_moe, "pipeline GPT is dense (use the SPMD MoE path)"
+    layers = [LayerSpec(GPTEmbed, config)]
+    layers += [LayerSpec(GPTPipeBlock, config) for _ in range(config.n_layer)]
+    layers += [LayerSpec(GPTHead, config)]
+
+    def loss_fn(logits, labels):
+        return cross_entropy_loss(logits, labels)
+
+    return PipelineModule(layers, num_stages=num_stages, loss_fn=loss_fn,
+                          partition_method=partition_method)

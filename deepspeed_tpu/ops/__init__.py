@@ -9,6 +9,7 @@ from deepspeed_tpu.ops.quantizer import (  # noqa: F401
     fake_quantize,
     int8_matmul,
     quantize,
+    quantize_weight_per_column,
 )
 from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb, rotary_angles  # noqa: F401
 

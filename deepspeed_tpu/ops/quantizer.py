@@ -96,19 +96,36 @@ def fake_quantize(x, num_bits=8, num_groups=1, symmetric=True,
     return dequantize(q, scale, zp, num_bits, dtype=x.dtype)
 
 
+def quantize_weight_per_column(w: jnp.ndarray, num_bits: int = 8
+                               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-column int quantization of a [in, out] weight —
+    the layout :func:`int8_matmul` consumes. (:func:`quantize`'s groups span
+    contiguous flattened chunks, i.e. ROW blocks of a 2-D weight, which is
+    the wrong axis for a matmul epilogue.)"""
+    assert w.ndim == 2, "per-column quantization expects a [in, out] matrix"
+    qmax = float(2 ** (num_bits - 1) - 1)
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / qmax  # [out]
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[None, :]),
+                 -qmax - 1, qmax).astype(jnp.int8 if num_bits <= 8
+                                         else jnp.int32)
+    return q, scale
+
+
 def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
                 preferred_dtype=jnp.bfloat16) -> jnp.ndarray:
-    """Matmul against a per-column-group int8 weight (inference int8 path,
+    """Matmul against a per-output-column int8 weight (inference int8 path,
     reference pt_binding int8 GEMM variants): dequantize rides the MXU
-    epilogue via scale multiply after an int8->bf16 cast."""
-    w = w_q.astype(preferred_dtype)
-    y = jnp.dot(x.astype(preferred_dtype), w,
-                preferred_element_type=jnp.float32)
+    epilogue via scale multiply after an int8->bf16 cast. Quantize the
+    weight with :func:`quantize_weight_per_column`."""
     if not (w_scale.ndim == 1 and w_scale.shape[0] == w_q.shape[-1]):
         raise ValueError(
             "int8_matmul needs per-output-column scales: w_scale shape "
             f"{w_scale.shape} does not match weight columns {w_q.shape[-1]} "
-            "(quantize the weight with num_groups == out_features)"
+            "(use quantize_weight_per_column)"
         )
+    w = w_q.astype(preferred_dtype)
+    y = jnp.dot(x.astype(preferred_dtype), w,
+                preferred_element_type=jnp.float32)
     y = y * w_scale[None, :]
     return y.astype(preferred_dtype)

@@ -1,3 +1,13 @@
 """Pipeline parallelism (reference deepspeed/runtime/pipe/)."""
 
-from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule  # noqa: F401
+from deepspeed_tpu.runtime.pipe.module import (  # noqa: F401
+    LayerSpec,
+    PipelineModule,
+    TiedLayerSpec,
+    partition_balanced,
+    partition_uniform,
+)
+from deepspeed_tpu.runtime.pipe.schedule import (  # noqa: F401
+    InferenceSchedule,
+    TrainSchedule,
+)

@@ -1,0 +1,491 @@
+"""Pipeline-parallel training engine.
+
+Parity with reference ``deepspeed/runtime/pipe/engine.py`` (PipelineEngine,
+``train_batch`` :296, instruction interpreter :1348-1377, p2p activation
+exchange :828-1153): stages execute a 1F1B schedule, exchange activations
+and activation-gradients, accumulate per-stage grads, and step together.
+
+TPU re-design (SURVEY.md §7 hard part (a)):
+
+* Each stage owns a **sub-mesh**: the slice of the global mesh at its ``pp``
+  coordinate, with the remaining axes (dp/fsdp/tp/...) intact — ZeRO and TP
+  compose per stage via the same ZeroShardingRules as the dense engine.
+* The host is the single controller. It walks the 1F1B clock stream
+  (pipe/schedule.py) and dispatches per-stage **jitted programs**; JAX async
+  dispatch overlaps stages on their devices, and activation transfer is a
+  ``jax.device_put`` onto the next stage's sub-mesh (ICI), replacing
+  torch.distributed send/recv + meta exchange (reference pipe/p2p.py:48-161).
+* Stage backward is **recompute-based** (jax.vjp inside one jitted program):
+  only the stage *input* is stored per in-flight micro batch — the 1F1B
+  activation footprint without hook machinery.
+* Tied layers (TiedLayerSpec) sync by summing grads across the owning stages
+  after the clock stream (reference pipe/module.py:417-436 tied-comm
+  allreduce).
+"""
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax import serialization
+
+from deepspeed_tpu.parallel.mesh import MeshTopology, set_default_topology
+from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRScheduler,
+    build_lr_scheduler,
+    schedule_fn_from_config,
+)
+from deepspeed_tpu.runtime.optimizer import build_optimizer
+from deepspeed_tpu.runtime.pipe.module import PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.timer import ThroughputTimer
+
+
+class _StageModule(nn.Module):
+    """Sequentially composes the LayerSpecs of one stage. Layers keep their
+    GLOBAL index in their param path so checkpoints are partition-invariant
+    (reference names layers by global id in module state files)."""
+
+    specs: Tuple
+    global_offset: int
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        for i, spec in enumerate(self.specs):
+            layer = spec.typename(*spec.module_args,
+                                  name=f"layer_{self.global_offset + i}",
+                                  **spec.module_kwargs)
+            try:
+                x = layer(x, deterministic=deterministic)
+            except TypeError:
+                x = layer(x)
+        return x
+
+
+class PipelineEngine:
+    """Train a PipelineModule over the ``pp`` mesh axis."""
+
+    def __init__(self, model: PipelineModule, config, topology=None,
+                 optimizer=None, lr_scheduler=None, seed: int = 0):
+        from deepspeed_tpu import comm
+        from deepspeed_tpu.parallel.mesh import topology_from_config
+
+        comm.init_distributed()
+        self.module = model
+        if not isinstance(config, DeepSpeedConfig):
+            config = DeepSpeedConfig(config)
+        self._config = config
+        if topology is None:
+            topology = topology_from_config(config.tpu.mesh_config)
+        self.topology = topology
+        set_default_topology(topology)
+
+        self.num_stages = (model.num_stages or topology.size("pp"))
+        assert self.num_stages == topology.size("pp"), (
+            f"PipelineModule wants {self.num_stages} stages but mesh pp axis "
+            f"is {topology.size('pp')}"
+        )
+        config._resolve_batch_triad(topology.data_parallel_size)
+
+        self.gradient_accumulation_steps = config.gradient_accumulation_steps
+        self.train_micro_batch_size_per_gpu = config.train_micro_batch_size_per_gpu
+        self.train_batch_size = config.train_batch_size
+        self.micro_batches = self.gradient_accumulation_steps
+        self.gradient_clipping = config.gradient_clipping
+        self.zero_stage = config.zero_config.stage
+        assert self.zero_stage <= 1, (
+            "ZeRO-2/3 cannot pair with pipeline parallelism (reference "
+            "engine raises the same; grads must persist across the schedule)"
+        )
+
+        # ---- stage sub-meshes -------------------------------------------
+        # mesh devices have shape (pp, dp, fsdp, ep, sp, tp)
+        sizes = topology.axis_sizes
+        self.stage_topos: List[MeshTopology] = []
+        for s in range(self.num_stages):
+            devs = topology.mesh.devices[s].flatten()
+            self.stage_topos.append(MeshTopology(
+                pp=1, dp=sizes["dp"], fsdp=sizes["fsdp"], ep=sizes["ep"],
+                sp=sizes["sp"], tp=sizes["tp"], devices=list(devs),
+            ))
+
+        # ---- partition layers into stages --------------------------------
+        bounds = model.partition(self.num_stages)
+        self.stage_bounds = bounds
+        self.stage_modules: List[_StageModule] = []
+        for s in range(self.num_stages):
+            specs = tuple(model.layer_specs[bounds[s]:bounds[s + 1]])
+            self.stage_modules.append(
+                _StageModule(specs=specs, global_offset=bounds[s]))
+
+        # tied-layer registry: key -> [(stage, local param name)]
+        self.tied_groups: Dict[str, List[Tuple[int, str]]] = {}
+        for s in range(self.num_stages):
+            for i, spec in enumerate(model.layer_specs[bounds[s]:bounds[s + 1]]):
+                if isinstance(spec, TiedLayerSpec):
+                    self.tied_groups.setdefault(spec.key, []).append(
+                        (s, f"layer_{bounds[s] + i}"))
+
+        # ---- optimizer / schedule ----------------------------------------
+        self.lr_scheduler, self._schedule_fn = self._configure_lr(lr_scheduler)
+        if optimizer is not None and isinstance(
+                optimizer, optax.GradientTransformation):
+            self._tx = optimizer
+        else:
+            self._tx = build_optimizer(
+                config.optimizer.type, config.optimizer.params,
+                self._schedule_fn, use_pallas=config.tpu.use_pallas_optimizer)
+        self.optimizer_adapter = self._tx  # returned from initialize()
+
+        self.checkpoint_engine = MsgpackCheckpointEngine()
+        self._rng = jax.random.PRNGKey(seed)
+        self._initialized = False
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size,
+            steps_per_output=config.steps_per_print)
+
+        log_dist(
+            f"PipelineEngine: stages={self.num_stages}, "
+            f"bounds={bounds}, micro_batches={self.micro_batches}, "
+            f"mesh={topology}", ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    def _configure_lr(self, lr_scheduler):
+        cfg = self._config
+        if lr_scheduler is None and cfg.scheduler.type is not None:
+            return (build_lr_scheduler(cfg.scheduler.type, cfg.scheduler.params),
+                    schedule_fn_from_config(cfg.scheduler.type,
+                                            cfg.scheduler.params))
+        if isinstance(lr_scheduler, LRScheduler):
+            return lr_scheduler, lr_scheduler.schedule_fn
+        if callable(lr_scheduler):
+            return LRScheduler(lr_scheduler), lr_scheduler
+        return None, None
+
+    # ------------------------------------------------------------------
+    # lazy init: build per-stage params/opt-state on their sub-meshes
+    # ------------------------------------------------------------------
+    def _init_state(self, first_inputs):
+        self._params: List[Any] = []
+        self._opt_states: List[Any] = []
+        self._param_shardings: List[Any] = []
+        self._opt_shardings: List[Any] = []
+        self._acc_grads: List[Any] = []
+        self._rules: List[ZeroShardingRules] = []
+        self._fwd_fns: List[Any] = [None] * self.num_stages
+        self._loss_fwd_fn = None
+        self._bwd_fns: List[Any] = [None] * self.num_stages
+        self._apply_fns: List[Any] = [None] * self.num_stages
+
+        x = first_inputs
+        rng = self._rng
+        for s in range(self.num_stages):
+            topo = self.stage_topos[s]
+            mod = self.stage_modules[s]
+            rules = ZeroShardingRules(topo, stage=self.zero_stage)
+            self._rules.append(rules)
+            rng_s = jax.random.fold_in(rng, s)
+
+            def init_fn(r, xv):
+                return mod.init({"params": r}, xv, deterministic=True)["params"]
+
+            shapes = jax.eval_shape(init_fn, rng_s, x)
+            p_shard = rules.param_sharding_tree(shapes)
+            params = jax.jit(init_fn, out_shardings=p_shard)(rng_s, x)
+            opt_shapes = jax.eval_shape(self._tx.init, shapes)
+            o_shard = rules.opt_sharding_tree(opt_shapes, shapes)
+            opt_state = jax.jit(self._tx.init, out_shardings=o_shard)(params)
+            self._params.append(params)
+            self._opt_states.append(opt_state)
+            self._param_shardings.append(p_shard)
+            self._opt_shardings.append(o_shard)
+            self._acc_grads.append(jax.tree.map(
+                lambda v: jnp.zeros(v.shape, jnp.float32), params))
+            # trace shapes through this stage for the next one's init
+            x = jax.eval_shape(
+                lambda p, xv, m=mod: m.apply({"params": p}, xv,
+                                             deterministic=True),
+                shapes, x)
+            x = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), x)
+            x = jax.device_put(
+                x, self.stage_topos[min(s + 1, self.num_stages - 1)]
+                .batch_sharding())
+        self._initialized = True
+        n = sum(int(np.prod(v.shape)) for p in self._params
+                for v in jax.tree.leaves(p))
+        log_dist(f"pipeline state materialized: {n/1e6:.1f}M params over "
+                 f"{self.num_stages} stages", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # per-stage compiled programs
+    # ------------------------------------------------------------------
+    def _fwd_fn(self, s):
+        if self._fwd_fns[s] is None:
+            mod = self.stage_modules[s]
+
+            def f(params, x, rng):
+                return mod.apply({"params": params}, x, deterministic=False,
+                                 rngs={"dropout": rng})
+
+            self._fwd_fns[s] = jax.jit(f)
+        return self._fwd_fns[s]
+
+    def _loss_fn(self, s, params, x, labels, rng):
+        mod = self.stage_modules[s]
+        out = mod.apply({"params": params}, x, deterministic=False,
+                        rngs={"dropout": rng})
+        if self.module.loss_fn is not None:
+            return self.module.loss_fn(out, labels)
+        return out  # last layer already returns loss
+
+    def _loss_fwd(self):
+        if self._loss_fwd_fn is None:
+            s = self.num_stages - 1
+            self._loss_fwd_fn = jax.jit(
+                lambda p, x, lab, r: self._loss_fn(s, p, x, lab, r))
+        return self._loss_fwd_fn
+
+    def _bwd_fn(self, s):
+        """Jitted recompute-backward: (params, x, g_out|labels) ->
+        (g_params, g_x[, loss])."""
+        if self._bwd_fns[s] is None:
+            mod = self.stage_modules[s]
+            last = s == self.num_stages - 1
+            gas = self.micro_batches
+
+            if last:
+                def b(params, x, labels, rng):
+                    def lf(p, xv):
+                        return self._loss_fn(s, p, xv, labels, rng) / gas
+
+                    (loss), vjp = jax.vjp(lf, params, x)
+                    gp, gx = vjp(jnp.float32(1.0))
+                    return gp, gx, loss * gas
+            else:
+                def b(params, x, g, rng):
+                    def f(p, xv):
+                        return mod.apply({"params": p}, xv,
+                                         deterministic=False,
+                                         rngs={"dropout": rng})
+
+                    _, vjp = jax.vjp(f, params, x)
+                    gp, gx = vjp(g)
+                    return gp, gx
+            self._bwd_fns[s] = jax.jit(b)
+        return self._bwd_fns[s]
+
+    def _apply_fn(self, s):
+        if self._apply_fns[s] is None:
+            tx = self._tx
+
+            def apply_step(params, opt_state, acc, factor):
+                grads = jax.tree.map(lambda g: g * factor, acc)
+                updates, new_opt = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                zero = jax.tree.map(jnp.zeros_like, acc)
+                return new_params, new_opt, zero
+
+            self._apply_fns[s] = jax.jit(
+                apply_step, donate_argnums=(0, 1, 2),
+                out_shardings=(self._param_shardings[s],
+                               self._opt_shardings[s], None))
+        return self._apply_fns[s]
+
+    # ------------------------------------------------------------------
+    # data plumbing
+    # ------------------------------------------------------------------
+    def _split_batch(self, batch: Dict[str, Any]):
+        """First-stage inputs vs last-stage labels (reference loads micro
+        batches at the first and last stages, pipe/engine.py:787)."""
+        batch = dict(batch)
+        labels = batch.pop("labels", None)
+        inputs = batch["input_ids"] if set(batch) == {"input_ids"} else batch
+        return inputs, labels
+
+    def _put(self, tree, stage):
+        sharding = self.stage_topos[stage].batch_sharding()
+        return jax.tree.map(
+            lambda v: jax.device_put(jnp.asarray(v), sharding), tree)
+
+    def deepspeed_io(self, dataset, collate_fn=None, shuffle=True):
+        global_micro = (self.train_micro_batch_size_per_gpu
+                        * self.topology.data_parallel_size)
+        return DeepSpeedDataLoader(dataset, batch_size=global_micro,
+                                   shuffle=shuffle, drop_last=True,
+                                   collate_fn=collate_fn)
+
+    # ------------------------------------------------------------------
+    # the 1F1B interpreter (reference _exec_schedule, pipe/engine.py:1361)
+    # ------------------------------------------------------------------
+    def train_batch(self, data_iter):
+        M, S = self.micro_batches, self.num_stages
+        inputs, labels = [], []
+        for _ in range(M):
+            x, lab = self._split_batch(next(data_iter))
+            inputs.append(self._put(x, 0))
+            labels.append(self._put(lab, S - 1) if lab is not None else None)
+        if not self._initialized:
+            self._init_state(inputs[0])
+
+        self._rng, step_rng = jax.random.split(self._rng)
+        rngs = [[jax.random.fold_in(jax.random.fold_in(step_rng, s), m)
+                 for m in range(M)] for s in range(S)]
+        self.tput_timer.start()
+
+        acts: Dict[Tuple[int, int], Any] = {}    # (stage, mb) -> stage input
+        grads_in: Dict[int, Any] = {}            # mb -> g wrt next-stage input
+        losses = []
+
+        sched = TrainSchedule(M, S)
+        for clock in sched.clocks():
+            for ins in clock:
+                s, m = ins.stage, ins.micro_batch
+                if ins.op == "load":
+                    acts[(0, m)] = inputs[m]
+                elif ins.op == "forward":
+                    x = acts[(s, m)]
+                    if s < S - 1:
+                        out = self._fwd_fn(s)(self._params[s], x, rngs[s][m])
+                        acts[(s + 1, m)] = jax.device_put(
+                            out, self.stage_topos[s + 1].batch_sharding())
+                    # last stage fwd is fused into its backward (recompute)
+                elif ins.op == "backward":
+                    x = acts[(s, m)]
+                    if s == S - 1:
+                        gp, gx, loss = self._bwd_fn(s)(
+                            self._params[s], x, labels[m], rngs[s][m])
+                        losses.append(loss)
+                    else:
+                        g = grads_in.pop(m)
+                        gp, gx = self._bwd_fn(s)(
+                            self._params[s], x, g, rngs[s][m])
+                    self._acc_grads[s] = jax.tree.map(
+                        jnp.add, self._acc_grads[s], gp)
+                    if s > 0:
+                        grads_in[m] = jax.device_put(
+                            gx, self.stage_topos[s - 1].batch_sharding())
+                        del acts[(s, m)]
+                    else:
+                        del acts[(s, m)]
+
+        self._sync_tied_grads()
+        self._optimizer_step()
+        self.global_steps += 1
+        self.micro_steps += M
+        self.global_samples += self.train_batch_size
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self.tput_timer.stop(global_step=True)
+        mean_loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
+        if self.global_steps % self._config.steps_per_print == 0:
+            log_dist(f"pipe step={self.global_steps} loss={float(mean_loss):.4f}",
+                     ranks=[0])
+        return mean_loss
+
+    def eval_batch(self, batch):
+        """Wavefront forward (reference InferenceSchedule); returns last-stage
+        output (loss if labels present)."""
+        x, labels = self._split_batch(batch)
+        if not self._initialized:
+            self._init_state(self._put(x, 0))
+        x = self._put(x, 0)
+        for s in range(self.num_stages - 1):
+            x = self.stage_modules[s].apply(
+                {"params": self._params[s]}, x, deterministic=True)
+            x = jax.device_put(x, self.stage_topos[s + 1].batch_sharding())
+        s = self.num_stages - 1
+        out = self.stage_modules[s].apply(
+            {"params": self._params[s]}, x, deterministic=True)
+        if labels is not None and self.module.loss_fn is not None:
+            return self.module.loss_fn(out, self._put(labels, s))
+        return out
+
+    # ------------------------------------------------------------------
+    def _sync_tied_grads(self):
+        """Sum grads of tied layers across their stages and distribute back
+        (reference pipe/module.py:417-436 allreduce over the tied comm
+        group)."""
+        for key, members in self.tied_groups.items():
+            if len(members) < 2:
+                continue
+            total = None
+            for s, lname in members:
+                g = self._acc_grads[s][lname]
+                g = jax.device_put(
+                    g, self.stage_topos[members[0][0]].replicated())
+                total = g if total is None else jax.tree.map(jnp.add, total, g)
+            for s, lname in members:
+                self._acc_grads[s] = dict(self._acc_grads[s])
+                self._acc_grads[s][lname] = jax.device_put(
+                    total, self.stage_topos[s].replicated())
+
+    def _optimizer_step(self):
+        # global grad-norm clip across stages (reference engine clips with
+        # the norm over ALL pipeline ranks); loss already carries the 1/gas
+        # scale, so no extra factor here
+        factor = 1.0
+        if self.gradient_clipping and self.gradient_clipping > 0:
+            sq = 0.0
+            for s in range(self.num_stages):
+                sq += float(optax.global_norm(self._acc_grads[s]) ** 2)
+            gnorm = float(np.sqrt(sq))
+            clip = min(1.0, self.gradient_clipping / (gnorm + 1e-6))
+        else:
+            clip = 1.0
+        for s in range(self.num_stages):
+            self._params[s], self._opt_states[s], self._acc_grads[s] = (
+                self._apply_fn(s)(self._params[s], self._opt_states[s],
+                                  self._acc_grads[s], jnp.float32(clip * factor))
+            )
+
+    # ------------------------------------------------------------------
+    # checkpoint (per-stage files; reference saves per-pp-rank states)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        assert self._initialized
+        tag = tag or f"global_step{self.global_steps}"
+        for s in range(self.num_stages):
+            self.checkpoint_engine.save(
+                {"module": serialization.to_state_dict(self._params[s])},
+                os.path.join(save_dir, str(tag),
+                             f"layer_bounds_{self.stage_bounds[s]}_"
+                             f"{self.stage_bounds[s+1]}_model_states.msgpack"))
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, **_):
+        if tag is None:
+            with open(os.path.join(load_dir, "latest")) as f:
+                tag = f.read().strip()
+        assert self._initialized, "run one batch (or init) before load"
+        for s in range(self.num_stages):
+            state = self.checkpoint_engine.load(
+                os.path.join(load_dir, str(tag),
+                             f"layer_bounds_{self.stage_bounds[s]}_"
+                             f"{self.stage_bounds[s+1]}_model_states.msgpack"))
+            restored = serialization.from_state_dict(
+                self._params[s], state["module"])
+            self._params[s] = jax.jit(
+                lambda t: t, out_shardings=self._param_shardings[s])(restored)
+        return tag, {}
+
+    @property
+    def params(self):
+        return self._params

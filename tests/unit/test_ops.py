@@ -164,6 +164,21 @@ class TestQuantizer:
         # deterministic rounding step
         assert abs(np.mean(outs) - 0.3) < 0.01
 
+    def test_int8_matmul_per_column(self):
+        from deepspeed_tpu.ops import int8_matmul, quantize_weight_per_column
+
+        w = jnp.array([[1.0, 2.0], [100.0, 0.5]])
+        q, s = quantize_weight_per_column(w)
+        y = int8_matmul(jnp.eye(2), q, s, preferred_dtype=jnp.float32)
+        # error bounded by half a quantization step PER COLUMN (the row-
+        # grouped scales this replaces were off by the whole outlier ratio)
+        err = np.abs(np.asarray(y - w))
+        assert (err <= np.asarray(s)[None, :] * 0.51).all(), (err, s)
+        # row-grouped scales from quantize() must be rejected
+        qq, ss, _ = quantize(w, num_groups=1)
+        with pytest.raises(ValueError):
+            int8_matmul(jnp.eye(2), qq, jnp.stack([ss[0]] * 3))
+
     def test_fake_quantize_shape_dtype(self):
         x = jax.random.normal(jax.random.PRNGKey(2), (8, 32), jnp.bfloat16)
         y = fake_quantize(x, num_bits=8, num_groups=8)
