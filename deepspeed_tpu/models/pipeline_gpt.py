@@ -75,5 +75,8 @@ def gpt_pipeline(config: GPTConfig, num_stages: Optional[int] = None,
     def loss_fn(logits, labels):
         return cross_entropy_loss(logits, labels)
 
+    from deepspeed_tpu.models.transformer_lm import gpt_tp_rules
+
     return PipelineModule(layers, num_stages=num_stages, loss_fn=loss_fn,
-                          partition_method=partition_method)
+                          partition_method=partition_method,
+                          tp_rules=gpt_tp_rules)

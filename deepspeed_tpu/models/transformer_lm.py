@@ -78,7 +78,7 @@ class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True):
+    def __call__(self, x, *, mask=None, deterministic=True, decode=False):
         cfg = self.config
         B, T, C = x.shape
         H, D = cfg.n_head, cfg.head_dim
@@ -89,6 +89,47 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
+
+        if decode:
+            # KV-cache append + attend (the reference's softmax_context
+            # kernel with its inference_context.h cache management,
+            # csrc/transformer/inference/). Chunk-aware: prefill writes T
+            # tokens at once, decode steps write one.
+            if mask is not None:
+                raise NotImplementedError(
+                    "decode attention is position-masked only; batched "
+                    "generation with padding masks is not supported — "
+                    "left-trim prompts to equal length instead")
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (B, cfg.n_positions, H, D), cfg.dtype)
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (B, cfg.n_positions, H, D), cfg.dtype)
+            cache_index = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32))
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            cache_index.value = idx + T
+            k_all, v_all = cached_k.value, cached_v.value
+
+            scale = 1.0 / np.sqrt(D)
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) * scale
+            q_pos = idx + jnp.arange(T)[:, None]            # [T, 1]
+            k_pos = jnp.arange(cfg.n_positions)[None, :]    # [1, max]
+            visible = k_pos <= q_pos                        # causal over cache
+            att = jnp.where(visible[None, None], att,
+                            jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(
+                cfg.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v_all)
+            y = y.reshape(B, T, C)
+            return nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                            name="c_proj")(y)
 
         # flash path needs 128-aligned seq (TPU tile constraint), no padding
         # mask, and no attention dropout (the kernel has none)
@@ -139,11 +180,11 @@ class Block(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True):
+    def __call__(self, x, *, mask=None, deterministic=True, decode=False):
         cfg = self.config
         x = x + CausalSelfAttention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x),
-            mask=mask, deterministic=deterministic)
+            mask=mask, deterministic=deterministic, decode=decode)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
         if cfg.is_moe:
             from deepspeed_tpu.moe.layer import MoE
@@ -178,7 +219,7 @@ class ScannedBlocks(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True):
+    def __call__(self, x, *, mask=None, deterministic=True, decode=False):
         cfg = self.config
         block_cls = Block
         if cfg.remat:
@@ -189,12 +230,13 @@ class ScannedBlocks(nn.Module):
 
         def body(block, carry):
             x, mask = carry
-            x, l_aux = block(x, mask=mask, deterministic=deterministic)
+            x, l_aux = block(x, mask=mask, deterministic=deterministic,
+                             decode=decode)
             return (x, mask), l_aux
 
         scanned = nn.scan(
             body,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True, "gating": True},
             length=cfg.n_layer,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -226,6 +268,8 @@ def gpt_tp_rules(path: str, shape) -> "PartitionSpec":
         return dim(-2)  # row parallel
     if path.endswith("wte/embedding"):
         return dim(0)   # vocab parallel (logits shard over vocab)
+    if path.endswith("lm_head/kernel"):
+        return dim(-1)  # vocab-parallel untied head (pipeline GPT)
     # expert-parallel MoE params (ep axis + Megatron tp inside each expert)
     from deepspeed_tpu.moe.layer import moe_param_spec
 
@@ -244,20 +288,28 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, attention_mask=None,
-                 deterministic=True):
+                 deterministic=True, decode=False):
         cfg = self.config
         B, T = input_ids.shape
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wte")
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wpe")
-        pos = jnp.arange(T)[None, :]
+        if decode:
+            # position offset tracked alongside the per-layer KV caches
+            position = self.variable("cache", "position",
+                                     lambda: jnp.zeros((), jnp.int32))
+            pos = position.value + jnp.arange(T)[None, :]
+            position.value = position.value + T
+        else:
+            pos = jnp.arange(T)[None, :]
         x = wte(input_ids) + wpe(pos)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         if cfg.scan_layers:
             x, l_aux = ScannedBlocks(cfg, name="h")(
-                x, mask=attention_mask, deterministic=deterministic)
+                x, mask=attention_mask, deterministic=deterministic,
+                decode=decode)
         else:
             l_aux = jnp.float32(0.0)
             for i in range(cfg.n_layer):
@@ -265,7 +317,8 @@ class GPT(nn.Module):
                 if cfg.remat:
                     blk = nn.remat(Block, prevent_cse=False)
                 x, aux_i = blk(cfg, name=f"h_{i}")(
-                    x, mask=attention_mask, deterministic=deterministic)
+                    x, mask=attention_mask, deterministic=deterministic,
+                    decode=decode)
                 l_aux = l_aux + aux_i
 
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)

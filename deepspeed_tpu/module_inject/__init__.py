@@ -1,0 +1,39 @@
+"""Injection policies — tensor-parallel sharding rules per architecture.
+
+Parity with reference ``deepspeed/module_inject/replace_policy.py`` (per-
+architecture weight maps: HFGPT2 :404, HFBert :124, ...) and
+``ReplaceWithTensorSlicing`` (replace_module.py:18): on TPU, "kernel
+injection with tensor slicing" is a ``path, shape -> PartitionSpec``
+function applied as jit shardings — no module surgery, XLA emits the
+column/row-parallel collectives (LinearLayer/LinearAllreduce,
+module_inject/layers.py:9/25) from the specs.
+"""
+
+from typing import Callable, Optional
+
+_POLICIES = {}
+
+
+def register_policy(name: str, rules: Callable) -> None:
+    _POLICIES[name.lower()] = rules
+
+
+def policy_for(model) -> Optional[Callable]:
+    """Resolve TP rules for a model: an explicit ``tp_rules`` attribute wins
+    (the generic path, like reference replace_wo_policy :773); otherwise the
+    registry is consulted by class name (the policy path :277)."""
+    rules = getattr(model, "tp_rules", None)
+    if rules is not None:
+        return rules
+    return _POLICIES.get(type(model).__name__.lower())
+
+
+def _builtin_policies():
+    from deepspeed_tpu.models.bert import bert_tp_rules
+    from deepspeed_tpu.models.transformer_lm import gpt_tp_rules
+
+    register_policy("gpt", gpt_tp_rules)
+    register_policy("bertforpretraining", bert_tp_rules)
+
+
+_builtin_policies()

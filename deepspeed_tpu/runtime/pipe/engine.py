@@ -60,13 +60,16 @@ class _StageModule(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
+        import inspect
+
         for i, spec in enumerate(self.specs):
             layer = spec.typename(*spec.module_args,
                                   name=f"layer_{self.global_offset + i}",
                                   **spec.module_kwargs)
-            try:
+            sig = inspect.signature(spec.typename.__call__)
+            if "deterministic" in sig.parameters:
                 x = layer(x, deterministic=deterministic)
-            except TypeError:
+            else:
                 x = layer(x)
         return x
 
@@ -186,7 +189,6 @@ class PipelineEngine:
         self._acc_grads: List[Any] = []
         self._rules: List[ZeroShardingRules] = []
         self._fwd_fns: List[Any] = [None] * self.num_stages
-        self._loss_fwd_fn = None
         self._bwd_fns: List[Any] = [None] * self.num_stages
         self._apply_fns: List[Any] = [None] * self.num_stages
 
@@ -195,7 +197,8 @@ class PipelineEngine:
         for s in range(self.num_stages):
             topo = self.stage_topos[s]
             mod = self.stage_modules[s]
-            rules = ZeroShardingRules(topo, stage=self.zero_stage)
+            rules = ZeroShardingRules(topo, stage=self.zero_stage,
+                                      tp_rules=self.module.tp_rules)
             self._rules.append(rules)
             rng_s = jax.random.fold_in(rng, s)
 
@@ -224,6 +227,7 @@ class PipelineEngine:
             x = jax.device_put(
                 x, self.stage_topos[min(s + 1, self.num_stages - 1)]
                 .batch_sharding())
+        self._sync_tied_params()
         self._initialized = True
         n = sum(int(np.prod(v.shape)) for p in self._params
                 for v in jax.tree.leaves(p))
@@ -251,13 +255,6 @@ class PipelineEngine:
         if self.module.loss_fn is not None:
             return self.module.loss_fn(out, labels)
         return out  # last layer already returns loss
-
-    def _loss_fwd(self):
-        if self._loss_fwd_fn is None:
-            s = self.num_stages - 1
-            self._loss_fwd_fn = jax.jit(
-                lambda p, x, lab, r: self._loss_fn(s, p, x, lab, r))
-        return self._loss_fwd_fn
 
     def _bwd_fn(self, s):
         """Jitted recompute-backward: (params, x, g_out|labels) ->
@@ -415,6 +412,22 @@ class PipelineEngine:
         return out
 
     # ------------------------------------------------------------------
+    def _sync_tied_params(self):
+        """Copy the first owner's tied-layer params to every other owner so
+        tied weights start identical; with grads synced every step they stay
+        identical (reference broadcasts tied weights from the owner rank at
+        init, pipe/module.py tied-weight setup)."""
+        for key, members in self.tied_groups.items():
+            if len(members) < 2:
+                continue
+            s0, name0 = members[0]
+            src = jax.device_get(self._params[s0][name0])
+            for s, lname in members[1:]:
+                tied = jax.tree.map(jnp.asarray, src)
+                self._params[s] = dict(self._params[s])
+                self._params[s][lname] = jax.device_put(
+                    tied, self.stage_topos[s].replicated())
+
     def _sync_tied_grads(self):
         """Sum grads of tied layers across their stages and distribute back
         (reference pipe/module.py:417-436 allreduce over the tied comm

@@ -82,12 +82,16 @@ class PipelineModule:
         loss_fn: Optional[Callable] = None,
         partition_method: str = "uniform",
         activation_checkpoint_interval: int = 0,
+        tp_rules: Optional[Callable] = None,
     ):
         self.layer_specs = list(layers)
         self.num_stages = num_stages
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        # tensor-parallel PartitionSpec rules applied per stage (the dense
+        # engine reads these off the model; pipeline layers declare them here)
+        self.tp_rules = tp_rules
 
     def partition(self, num_stages: int) -> List[int]:
         method = self.partition_method.lower()

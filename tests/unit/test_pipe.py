@@ -146,6 +146,33 @@ class TestPipelineEngine:
         assert got == pytest.approx(ref_losses[0], rel=1e-5)
         assert float(loss0) == pytest.approx(ref_losses[0], rel=1e-5)
 
+    def test_pipeline_with_tensor_parallel(self, eight_devices):
+        """pp x tp x dp: stage params must carry Megatron tp specs."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.pipeline_gpt import gpt_pipeline
+        from deepspeed_tpu.models.transformer_lm import GPTConfig
+        from deepspeed_tpu.parallel.mesh import MeshTopology
+
+        topo = MeshTopology(pp=2, tp=2, dp=2, devices=eight_devices)
+        cfg = GPTConfig(vocab_size=128, n_positions=32, n_embd=32,
+                        n_layer=2, n_head=4, dtype=jnp.float32,
+                        scan_layers=False)
+        ds_config = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt_pipeline(cfg, num_stages=2), config=ds_config,
+            topology=topo)
+        gb = engine.train_micro_batch_size_per_gpu * topo.data_parallel_size
+        loss = engine.train_batch(iter(self._batches(cfg, gb, 2)))
+        assert np.isfinite(float(loss))
+        specs = [str(x.sharding.spec) for p in engine.params
+                 for x in jax.tree.leaves(p)]
+        assert any("tp" in s for s in specs), specs
+
     def test_checkpoint_roundtrip(self, eight_devices, tmp_path):
         engine, cfg, topo = self._build(eight_devices, pp=2, dp=4, gas=2)
         gb = engine.train_micro_batch_size_per_gpu * topo.data_parallel_size
