@@ -101,10 +101,17 @@ class InferenceEngine:
             # GEMMs via ops.int8_matmul are a model-level opt-in
             from deepspeed_tpu.ops.quantizer import quantize_weight_per_column
 
+            def q2d(x):
+                q, s = quantize_weight_per_column(x, num_bits=8)
+                return (q.astype(jnp.float32) * s[None, :]).astype(x.dtype)
+
             def maybe_q(path, x):
-                if path.endswith("kernel") and x.ndim == 2:
-                    q, s = quantize_weight_per_column(x, num_bits=8)
-                    return (q.astype(jnp.float32) * s[None, :]).astype(x.dtype)
+                if not path.endswith("kernel"):
+                    return x
+                if x.ndim == 2:
+                    return q2d(x)
+                if x.ndim == 3:  # scan-stacked layers: (n_layer, in, out)
+                    return jax.vmap(q2d)(x)
                 return x
 
             from deepspeed_tpu.utils.tree import path_str
@@ -200,6 +207,10 @@ class InferenceEngine:
                  temperature: float = 0.0):
         """Greedy (temperature=0) or sampled generation."""
         input_ids = jnp.asarray(input_ids)
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+        if max_new_tokens == 0:
+            return jnp.zeros((input_ids.shape[0], 0), jnp.int32)
         max_pos = getattr(getattr(self.module, "config", None),
                           "n_positions", None)
         if max_pos is not None and input_ids.shape[1] + max_new_tokens > max_pos:

@@ -37,6 +37,9 @@ class GPTConfig:
     remat: bool = False
     scan_layers: bool = True
     use_flash_attention: bool = False  # Pallas kernel path (ops/pallas)
+    # sequence/context parallelism over the sp mesh axis
+    # (parallel/sequence.py): "none" | "ring" | "ulysses"
+    sequence_parallel: str = "none"
     # MoE (reference deepspeed/moe/): 0 experts = dense MLP everywhere
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -130,6 +133,24 @@ class CausalSelfAttention(nn.Module):
             y = y.reshape(B, T, C)
             return nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                             name="c_proj")(y)
+
+        # like the flash path, sp attention has no attention-prob dropout
+        if (cfg.sequence_parallel != "none" and mask is None
+                and (cfg.dropout == 0.0 or deterministic)):
+            from deepspeed_tpu.parallel.mesh import get_default_topology
+            from deepspeed_tpu.parallel.sequence import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            if get_default_topology().size("sp") > 1:
+                attn_fn = {"ring": ring_attention,
+                           "ulysses": ulysses_attention}[cfg.sequence_parallel]
+                y = attn_fn(q, k, v, causal=True)
+                y = y.reshape(B, T, C)
+                y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             name="c_proj")(y)
+                return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
 
         # flash path needs 128-aligned seq (TPU tile constraint), no padding
         # mask, and no attention dropout (the kernel has none)

@@ -1,0 +1,152 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+ABSENT in the reference snapshot (SURVEY.md §2.2 — DeepSpeed-Ulysses landed
+~v0.10); first-class here because long-context is a headline TPU capability.
+
+* **Ring attention**: Q stays put; K/V chunks rotate around the ``sp`` ring
+  via ``lax.ppermute`` while each step folds one chunk into an online-softmax
+  accumulator — attention memory O(T/sp) per device, comm rides ICI
+  neighbour links (blockwise-parallel transformer / ring attention papers,
+  see PAPERS.md).
+* **Ulysses**: ``lax.all_to_all`` re-shards [seq/sp, heads] -> [seq,
+  heads/sp]; each device runs FULL attention for its head slice, then the
+  inverse all-to-all restores sequence sharding (DeepSpeed-Ulysses
+  semantics).
+
+Both are expressed with ``jax.shard_map`` over the named mesh so they
+compose with dp/fsdp/tp axes and differentiate through (ppermute/all_to_all
+have exact transposes).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import BATCH_AXES, get_default_topology
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# ring attention (local function; runs inside shard_map)
+# ---------------------------------------------------------------------------
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: float):
+    """q/k/v: LOCAL [B, C, H, D] chunks of the sp-sharded sequence."""
+    sp = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, C, H, D = q.shape
+
+    qf = q.astype(jnp.float32) * scale
+    m0 = jnp.full((B, C, H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, C, H, 1), jnp.float32)
+    acc0 = jnp.zeros((B, C, H, D), jnp.float32)
+
+    q_pos = my * C + jnp.arange(C)
+
+    def step(carry, step_idx):
+        k_cur, v_cur, m, l, acc = carry
+        src = (my - step_idx) % sp  # whose chunk we hold this step
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = src * C + jnp.arange(C)
+            vis = q_pos[:, None] >= k_pos[None, :]      # [C, C]
+            s = jnp.where(vis[None, :, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bqhk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        # rotate K/V to the next neighbour (ICI ring)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(sp))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses attention (local function; runs inside shard_map)
+# ---------------------------------------------------------------------------
+def _ulysses_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                             scale: float):
+    """q/k/v: LOCAL [B, C, H, D]; all_to_all to [B, T, H/sp, D], full
+    attention per head slice, all_to_all back."""
+    sp = jax.lax.psum(1, axis_name)
+    B, C, H, D = q.shape
+
+    def scatter_heads(x):
+        # [B, C, H, D] -> [B, sp*C, H/sp, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def gather_heads(x):
+        # inverse
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    T = qh.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) * scale
+    if causal:
+        vis = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(vis[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
+    return gather_heads(out.astype(q.dtype))
+
+
+# ---------------------------------------------------------------------------
+# public wrappers: global arrays -> shard_map over the default mesh
+# ---------------------------------------------------------------------------
+def _wrap(local_fn, q, k, v, causal, scale):
+    topo = get_default_topology()
+    sp = topo.size("sp")
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if sp <= 1:
+        raise ValueError("sequence-parallel attention needs an sp mesh axis "
+                         "> 1 (got sp=1)")
+    assert q.shape[1] % sp == 0, (
+        f"seq len {q.shape[1]} not divisible by sp={sp}")
+
+    batch = tuple(a for a in BATCH_AXES if topo.size(a) > 1) or None
+    head = "tp" if topo.size("tp") > 1 else None
+    spec = P(batch, "sp", head, None)
+
+    fn = functools.partial(local_fn, axis_name="sp", causal=causal,
+                           scale=float(scale))
+    return jax.shard_map(
+        fn, mesh=topo.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def ring_attention(q, k, v, *, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Ring attention over the sp axis; q/k/v are GLOBAL
+    [batch, seq, heads, head_dim] arrays (sharded by the caller's jit)."""
+    return _wrap(_ring_attention_local, q, k, v, causal, scale)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = True,
+                      scale: Optional[float] = None):
+    """DeepSpeed-Ulysses-style all-to-all head-parallel attention over sp."""
+    topo = get_default_topology()
+    sp = topo.size("sp")
+    # heads are sharded over tp first; the all_to_all splits the LOCAL count
+    local_heads = q.shape[2] // max(topo.size("tp"), 1)
+    assert local_heads % sp == 0, (
+        f"ulysses needs per-device heads ({q.shape[2]} / tp="
+        f"{topo.size('tp')} = {local_heads}) divisible by sp ({sp})")
+    return _wrap(_ulysses_attention_local, q, k, v, causal, scale)

@@ -426,6 +426,7 @@ class DeepSpeedEngine:
     def _put_batch(self, batch: Dict[str, Any]):
         sharding = self.topology.batch_sharding()
         dp = self.topology.data_parallel_size
+        sp = self.topology.size("sp")
         expected = self.train_micro_batch_size_per_gpu * dp
 
         def put(x):
@@ -436,6 +437,11 @@ class DeepSpeedEngine:
                     f"batch (train_micro_batch_size_per_gpu * dp = "
                     f"{self.train_micro_batch_size_per_gpu} * {dp} = {expected})"
                 )
+            if sp > 1 and x.ndim >= 2 and x.shape[1] % sp == 0:
+                # shard the sequence dim over sp (context parallelism)
+                spec = list(sharding.spec) + [None] * (x.ndim - len(sharding.spec))
+                spec[1] = "sp"
+                return jax.device_put(x, self.topology.sharding(*spec))
             return jax.device_put(x, sharding)
 
         return jax.tree.map(put, batch)
