@@ -51,6 +51,12 @@ class GPTConfig:
     moe_noisy_gate_policy: Optional[str] = None
     moe_use_rts: bool = True
 
+    def __post_init__(self):
+        if self.sequence_parallel not in ("none", "ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel must be 'none', 'ring', or 'ulysses'; "
+                f"got {self.sequence_parallel!r}")
+
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head

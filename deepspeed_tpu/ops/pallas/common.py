@@ -1,0 +1,21 @@
+"""Shared constants/helpers for the Pallas kernel library."""
+
+import jax
+
+NEG_INF = -1e30
+# logsumexp rows carry 8 broadcast sublane copies to satisfy TPU tiling
+LSE_LANES = 8
+
+
+def interpret() -> bool:
+    """Run kernels in interpreter mode off-TPU so the CPU test mesh
+    exercises the same code path."""
+    return jax.default_backend() != "tpu"
+
+
+def largest_divisor_block(t: int, want: int = 128) -> int:
+    """Largest block size <= want dividing t."""
+    b = min(want, t)
+    while t % b:
+        b -= 1
+    return b

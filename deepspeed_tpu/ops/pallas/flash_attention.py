@@ -22,20 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-NEG_INF = -1e30
-LSE_LANES = 8  # sublane-padded copies for TPU tile constraints
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _block(t: int, want: int = 128) -> int:
-    """Largest block size <= want dividing t."""
-    b = min(want, t)
-    while t % b:
-        b -= 1
-    return b
+from deepspeed_tpu.ops.pallas.common import (
+    LSE_LANES,
+    NEG_INF,
+    interpret as _interpret,
+    largest_divisor_block as _block,
+)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,24 @@
+"""Block-sparse attention for TPU.
+
+Capability surface of reference ``deepspeed/ops/sparse_attention`` (Triton
+block-sparse matmul/softmax + SparsityConfig family,
+``ops/sparse_attention/sparsity_config.py:9-743``,
+``sparse_self_attention.py:11``) rebuilt as a Pallas splash-style kernel:
+the sparsity layout is a static block mask compiled into the kernel's block
+index lists, so only active [block, block] tiles are ever computed.
+"""
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (  # noqa: F401
+    SparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (  # noqa: F401
+    SparseSelfAttention,
+    block_sparse_attention,
+    dense_blocksparse_attention,
+)
