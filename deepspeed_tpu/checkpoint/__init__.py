@@ -1,0 +1,26 @@
+"""Checkpoint inspection, conversion, and resharding.
+
+Capability surface of reference ``deepspeed/checkpoint/`` (DeepSpeedCheckpoint
+``deepspeed_checkpoint.py:37``, universal checkpoints
+``universal_checkpoint.py:13``, 2D/3D reshapers ``reshape_meg_2d.py``,
+``reshape_3d_utils.py``). TPU re-design: engine checkpoints already store
+logically-global arrays, so "reshape across dp/tp/pp changes" is a no-op at
+load; this package adds (a) the universal per-parameter fp32 format for
+cross-framework/optimizer-state portability, (b) TP merge/split math for
+importing externally sharded (Megatron-style) checkpoints, and (c) a
+checkpoint inspector.
+"""
+
+from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (  # noqa: F401
+    DeepSpeedCheckpoint,
+)
+from deepspeed_tpu.checkpoint.reshape_utils import (  # noqa: F401
+    merge_tp_slices,
+    reshape_tp_degree,
+    split_tp_param,
+)
+from deepspeed_tpu.checkpoint.universal_checkpoint import (  # noqa: F401
+    convert_to_universal,
+    load_universal_into_engine,
+    load_universal_state,
+)

@@ -1,0 +1,107 @@
+"""Checkpoint inspector (reference ``checkpoint/deepspeed_checkpoint.py:37``).
+
+The reference class enumerates ``mp_rank_*`` / ``zero_pp_rank_*`` file grids
+and exposes tp/pp/dp degrees plus per-layer file maps so reshape tools can
+walk them. Our checkpoints are logically-global (one model-states file, one
+optim-states file per tag), so the inspector's job is simpler: resolve tags,
+enumerate contents, and expose flat ``name -> array`` views of module and
+optimizer state.
+"""
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from flax import serialization, traverse_util
+
+
+def _flatten(tree: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    return {
+        ".".join(k): v
+        for k, v in traverse_util.flatten_dict(tree).items()
+    }
+
+
+class DeepSpeedCheckpoint:
+    """Inspect a deepspeed_tpu checkpoint directory."""
+
+    MODEL_FILE = "mp_rank_00_model_states.msgpack"
+    OPTIM_FILE = "zero_pp_rank_0_mp_rank_00_optim_states.msgpack"
+
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        self.ckpt_dir = ckpt_dir
+        self.tag = tag or self._read_latest()
+        self.tag_dir = os.path.join(ckpt_dir, str(self.tag))
+        if not os.path.isdir(self.tag_dir):
+            raise FileNotFoundError(f"no checkpoint tag dir {self.tag_dir}")
+        self._model_state = None
+        self._optim_state = None
+
+    def _read_latest(self) -> str:
+        latest = os.path.join(self.ckpt_dir, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(
+                f"no 'latest' file in {self.ckpt_dir}; pass tag explicitly")
+        with open(latest) as f:
+            return f.read().strip()
+
+    # ------------------------------------------------------------------
+    # layout queries (reference exposes tp/pp/dp degrees; ours are logical)
+    # ------------------------------------------------------------------
+    @property
+    def tp_degree(self) -> int:
+        return 1  # files are unsharded; TP is a runtime property
+
+    @property
+    def pp_degree(self) -> int:
+        return 1
+
+    @property
+    def dp_degree(self) -> int:
+        return 1
+
+    def list_tags(self) -> List[str]:
+        return sorted(
+            d for d in os.listdir(self.ckpt_dir)
+            if os.path.isdir(os.path.join(self.ckpt_dir, d)))
+
+    def list_files(self) -> List[str]:
+        return sorted(os.listdir(self.tag_dir))
+
+    # ------------------------------------------------------------------
+    # content access
+    # ------------------------------------------------------------------
+    def _load(self, fname: str) -> Dict[str, Any]:
+        path = os.path.join(self.tag_dir, fname)
+        with open(path, "rb") as f:
+            return serialization.msgpack_restore(f.read())
+
+    def module_state(self) -> Dict[str, np.ndarray]:
+        """Flat ``name -> array`` view of model weights."""
+        if self._model_state is None:
+            self._model_state = self._load(self.MODEL_FILE)
+        return _flatten(self._model_state.get("module", self._model_state))
+
+    def optimizer_state(self) -> Dict[str, np.ndarray]:
+        if self._optim_state is None:
+            self._optim_state = self._load(self.OPTIM_FILE)
+        return _flatten(self._optim_state.get("optimizer",
+                                              self._optim_state))
+
+    def parameter_names(self) -> List[str]:
+        return sorted(self.module_state().keys())
+
+    def num_parameters(self) -> int:
+        return int(sum(int(np.prod(v.shape))
+                       for v in self.module_state().values()
+                       if hasattr(v, "shape")))
+
+    def show_summary(self) -> str:
+        lines = [f"checkpoint {self.ckpt_dir} tag={self.tag}",
+                 f"  files: {self.list_files()}",
+                 f"  params: {self.num_parameters():,}"]
+        for name, arr in sorted(self.module_state().items()):
+            shape = getattr(arr, "shape", ())
+            dtype = getattr(arr, "dtype", type(arr).__name__)
+            lines.append(f"  {name}: {tuple(shape)} {dtype}")
+        return "\n".join(lines)

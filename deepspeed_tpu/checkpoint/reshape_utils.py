@@ -1,0 +1,94 @@
+"""Tensor-parallel merge/split math for checkpoint import/export.
+
+Reference analogue: ``checkpoint/reshape_meg_2d.py`` + the qkv merge/split
+logic in ``runtime/state_dict_factory.py:214`` (MegatronSDLoader). Used to
+(a) import Megatron/DeepSpeed TP-sharded checkpoints into the logically-
+global format, and (b) export global weights back out at a requested TP
+degree. Strategies:
+
+* ``column`` — output-dim sharding (Megatron ColumnParallelLinear): slices
+  concatenate on the output axis.
+* ``row`` — input-dim sharding (RowParallelLinear): slices concatenate on
+  the input axis.
+* ``qkv`` — fused attention projection: each slice holds [q_i; k_i; v_i],
+  so a plain concat would interleave wrongly; merge splits each slice into
+  its q/k/v thirds first, then concatenates per-projection.
+* ``replicate`` — layernorms/biases of row-parallel layers: all slices are
+  identical; merge takes slice 0, split copies.
+"""
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _check_same_rank(slices: Sequence[np.ndarray]):
+    if not slices:
+        raise ValueError("no slices given")
+    shapes = {s.ndim for s in slices}
+    if len(shapes) != 1:
+        raise ValueError("slices differ in rank")
+
+
+def merge_tp_slices(slices: Sequence[np.ndarray], strategy: str = "column",
+                    axis: int = None) -> np.ndarray:
+    """Merge per-TP-rank weight slices into one global array."""
+    slices = [np.asarray(s) for s in slices]
+    _check_same_rank(slices)
+    if strategy == "replicate":
+        return slices[0]
+    if strategy == "column":
+        ax = 0 if axis is None else axis
+        return np.concatenate(slices, axis=ax)
+    if strategy == "row":
+        ax = (slices[0].ndim - 1) if axis is None else axis
+        return np.concatenate(slices, axis=ax)
+    if strategy == "qkv":
+        ax = 0 if axis is None else axis
+        parts = {0: [], 1: [], 2: []}
+        for s in slices:
+            if s.shape[ax] % 3:
+                raise ValueError(
+                    f"qkv slice axis {ax} size {s.shape[ax]} not divisible "
+                    f"by 3")
+            q, k, v = np.split(s, 3, axis=ax)
+            parts[0].append(q)
+            parts[1].append(k)
+            parts[2].append(v)
+        return np.concatenate(
+            [np.concatenate(parts[i], axis=ax) for i in range(3)], axis=ax)
+    raise ValueError(f"unknown merge strategy {strategy!r}")
+
+
+def split_tp_param(param: np.ndarray, degree: int,
+                   strategy: str = "column",
+                   axis: int = None) -> List[np.ndarray]:
+    """Split one global array into ``degree`` per-TP-rank slices (inverse of
+    :func:`merge_tp_slices`)."""
+    param = np.asarray(param)
+    if strategy == "replicate":
+        return [param.copy() for _ in range(degree)]
+    if strategy == "column":
+        ax = 0 if axis is None else axis
+        return list(np.split(param, degree, axis=ax))
+    if strategy == "row":
+        ax = (param.ndim - 1) if axis is None else axis
+        return list(np.split(param, degree, axis=ax))
+    if strategy == "qkv":
+        ax = 0 if axis is None else axis
+        q, k, v = np.split(param, 3, axis=ax)
+        qs = np.split(q, degree, axis=ax)
+        ks = np.split(k, degree, axis=ax)
+        vs = np.split(v, degree, axis=ax)
+        return [np.concatenate([qs[i], ks[i], vs[i]], axis=ax)
+                for i in range(degree)]
+    raise ValueError(f"unknown split strategy {strategy!r}")
+
+
+def reshape_tp_degree(slices: Sequence[np.ndarray], new_degree: int,
+                      strategy: str = "column",
+                      axis: int = None) -> List[np.ndarray]:
+    """Re-shard from one TP degree to another (reference reshape_meg_2d
+    ``reshape_tp_dimension``): merge to global, split at the new degree."""
+    merged = merge_tp_slices(slices, strategy, axis)
+    return split_tp_param(merged, new_degree, strategy, axis)

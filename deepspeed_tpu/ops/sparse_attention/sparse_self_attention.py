@@ -33,15 +33,24 @@ def _pad_lanes(n: int, mult: int = 128) -> int:
     return ((n + mult - 1) // mult) * mult
 
 
+# index-table rows are replicated over 8 sublanes so their [8, width] tiles
+# satisfy TPU Mosaic lowering (same trick as the LSE_LANES rows)
+IDX_SUBLANES = 8
+
+
 def _build_index_tables(layout: np.ndarray, num_heads: int):
     """Static per-row active-block index lists, padded with -1.
 
-    Returns ``(kidx [H, nq, width_k], n_k)`` — active key blocks per query
-    row and the true max active count bounding the kernel loop — and the
-    analogous ``(qidx [H, nk, width_q], n_q)`` for the dkv iteration order.
-    Table width is lane-padded to 128; only the first n_* entries are real.
+    Returns ``(kidx [H, nq, IDX_SUBLANES, width_k], n_k)`` — active key
+    blocks per query row and the true max active count bounding the kernel
+    loop — and the analogous ``(qidx [H, nk, IDX_SUBLANES, width_q], n_q)``
+    for the dkv iteration order. Table width is lane-padded to 128; only the
+    first n_* entries are real.
     """
     h_layout, nq, nk = layout.shape
+    if h_layout not in (1, num_heads):
+        raise ValueError(
+            f"layout has {h_layout} head layouts; expected 1 or {num_heads}")
     layout = np.broadcast_to(layout, (num_heads, nq, nk)) \
         if h_layout == 1 else layout
 
@@ -55,6 +64,7 @@ def _build_index_tables(layout: np.ndarray, num_heads: int):
             for r in range(mat_rows.shape[1]):
                 idx = np.nonzero(mat_rows[h, r])[0]
                 out[h, r, :len(idx)] = idx
+        out = np.repeat(out[:, :, None, :], IDX_SUBLANES, axis=2)
         return out, n_iter
 
     kidx, n_k = tables(layout)
@@ -76,7 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kidx_ref, o_ref, lse_ref, *, scale,
     bq, d = q_ref.shape
     qi = pl.program_id(1)
     q = q_ref[...].astype(jnp.float32) * scale
-    row = kidx_ref[...]  # [1, width_k]
+    row = kidx_ref[...][0:1, :]  # [1, width_k]
 
     m = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
@@ -124,7 +134,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kidx_ref,
     do = do_ref[...].astype(jnp.float32)
     lse = lse_ref[...][:, :1]
     delta = delta_ref[...][:, :1]
-    row = kidx_ref[...]
+    row = kidx_ref[...][0:1, :]
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
     dq = jnp.zeros((bq, d), jnp.float32)
 
@@ -161,7 +171,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qidx_ref,
     ki = pl.program_id(1)
     k = k_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
-    row = qidx_ref[...]
+    row = qidx_ref[...][0:1, :]
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block, bk), 1)
     dk = jnp.zeros((bk, d), jnp.float32)
     dv = jnp.zeros((bk, d), jnp.float32)
@@ -210,8 +220,8 @@ _OP_CACHE_MAX = 64
 
 def _build_op(layout, num_heads, scale, causal, block):
     kidx, n_k, qidx, n_q = _build_index_tables(layout, num_heads)
-    h, nq, width_k = kidx.shape
-    _, nk, width_q = qidx.shape
+    h, nq, _, width_k = kidx.shape
+    _, nk, _, width_q = qidx.shape
     kidx_c = jnp.asarray(kidx)
     qidx_c = jnp.asarray(qidx)
 
@@ -230,8 +240,8 @@ def _build_op(layout, num_heads, scale, causal, block):
                 pl.BlockSpec((None, block, d), lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, None, width_k),
-                             lambda i, j: (i % h, j, 0)),
+                pl.BlockSpec((None, None, IDX_SUBLANES, width_k),
+                             lambda i, j: (i % h, j, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((None, block, d), lambda i, j: (i, j, 0)),
@@ -286,8 +296,8 @@ def _build_op(layout, num_heads, scale, causal, block):
                              lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, block, LSE_LANES),
                              lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, None, width_k),
-                             lambda i, j: (i % h, j, 0)),
+                pl.BlockSpec((None, None, IDX_SUBLANES, width_k),
+                             lambda i, j: (i % h, j, 0, 0)),
             ],
             out_specs=pl.BlockSpec((None, block, d), lambda i, j: (i, j, 0)),
             out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -305,8 +315,8 @@ def _build_op(layout, num_heads, scale, causal, block):
                 pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, None, width_q),
-                             lambda i, j: (i % h, j, 0)),
+                pl.BlockSpec((None, None, IDX_SUBLANES, width_q),
+                             lambda i, j: (i % h, j, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((None, block, d), lambda i, j: (i, j, 0)),
@@ -340,7 +350,8 @@ def block_sparse_attention(q, k, v, layout, *, block: int,
         raise ValueError(
             f"layout covers {layout.shape[1] * block} positions, "
             f"inputs have {t}")
-    key = (layout.tobytes(), heads, float(scale), bool(causal), int(block))
+    key = (layout.tobytes(), layout.shape, str(layout.dtype), heads,
+           float(scale), bool(causal), int(block))
     op = _OP_CACHE.get(key)
     if op is None:
         op = _build_op(layout, heads, float(scale), bool(causal), int(block))

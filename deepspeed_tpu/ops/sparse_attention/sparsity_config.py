@@ -193,10 +193,13 @@ class VariableSparsityConfig(SparsityConfig):
                 layout[h, :, s:e] = 1
                 if self.horizontal_global_attention:
                     layout[h, s:e, :] = 1
-            # random blocks per row
+            # random blocks per row; unidirectional draws from the past so
+            # the tril in _apply_direction doesn't discard the picks
             for row in range(num_blocks):
-                cols = rng.choice(num_blocks,
-                                  size=min(self.num_random_blocks, num_blocks),
+                pool = row + 1 if self.attention == "unidirectional" \
+                    else num_blocks
+                cols = rng.choice(pool,
+                                  size=min(self.num_random_blocks, pool),
                                   replace=False)
                 layout[h, row, cols] = 1
             layout[h] = self._apply_direction(layout[h:h + 1],

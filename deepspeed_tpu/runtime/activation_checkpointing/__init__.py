@@ -1,0 +1,11 @@
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (  # noqa: F401
+    CheckpointFunction,
+    checkpoint,
+    checkpoint_wrapper,
+    configure,
+    get_rng_tracker,
+    is_configured,
+    model_parallel_reconfigure,
+    policy_from_config,
+    reset,
+)

@@ -1,0 +1,203 @@
+"""Activation checkpointing — the TPU-native form of reference
+``deepspeed/runtime/activation_checkpointing/checkpointing.py``.
+
+The reference's ``CheckpointFunction`` (:499) re-runs the forward in the
+backward pass with manually stashed RNG states, optionally partitioning the
+stored activations across model-parallel ranks (:373) or moving them to CPU.
+On TPU all of that maps onto ``jax.checkpoint`` (remat):
+
+* recompute-in-backward  → ``jax.checkpoint(fn, policy=...)``
+* ``partition_activations`` → saved residuals inherit the pjit shardings of
+  the inputs, so under tensor parallelism they are *already* partitioned;
+  the flag is accepted and simply documents intent.
+* ``cpu_checkpointing`` → offload policy (``save_and_offload_only_these_names``
+  on named residuals / ``offload_dot_with_no_batch_dims``): residuals live in
+  host memory between forward and backward.
+* Megatron RNG-state tracker (:122-241) → explicit key splitting; a small
+  named-key tracker is provided for porting Megatron-style dropout code.
+
+``configure()`` / ``is_configured()`` / ``checkpoint()`` keep the reference's
+module-level API so engine and user code can be written identically.
+"""
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+_CONFIG = None
+_LOCK = threading.Lock()
+
+
+def policy_from_config(ac_config=None, remat: str = "full"):
+    """Map a config block to a ``jax.checkpoint`` rematerialization policy.
+
+    ``remat`` mirrors the tpu.remat config key: ``none`` (save everything —
+    checkpointing disabled), ``full`` (save nothing, recompute all), or
+    ``selective`` (save matmul outputs, recompute elementwise — the right
+    default on TPU where recomputing MXU work is expensive but VPU work is
+    cheap).
+    """
+    cp = jax.checkpoint_policies
+    if ac_config is not None and getattr(ac_config, "cpu_checkpointing",
+                                         False):
+        # keep dot outputs, but in host memory between fwd and bwd
+        return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+    if remat == "none":
+        return cp.everything_saveable
+    if remat == "selective":
+        return cp.dots_with_no_batch_dims_saveable
+    if remat == "full":
+        return cp.nothing_saveable
+    raise ValueError(f"unknown remat policy {remat!r}")
+
+
+class _ActCkptState:
+    def __init__(self, ac_config=None, remat: str = "full"):
+        self.config = ac_config
+        self.remat = remat
+        self.policy = policy_from_config(ac_config, remat)
+        self.profile = bool(getattr(ac_config, "profile", False))
+        self.number_checkpoints = getattr(ac_config, "number_checkpoints",
+                                          None)
+
+
+def configure(deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              remat: str = "full"):
+    """Module-level setup (reference checkpointing.py ``configure``).
+
+    Accepts either an engine config object carrying an
+    ``activation_checkpointing`` block or the reference's keyword flags.
+    """
+    global _CONFIG
+    ac = None
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing", None)
+    if ac is None:
+        from deepspeed_tpu.runtime.config import \
+            ActivationCheckpointingConfig
+        ac = ActivationCheckpointingConfig()
+    if partition_activations is not None:
+        ac.partition_activations = partition_activations
+    if contiguous_checkpointing is not None:
+        ac.contiguous_memory_optimization = contiguous_checkpointing
+    if num_checkpoints is not None:
+        ac.number_checkpoints = num_checkpoints
+    if checkpoint_in_cpu is not None:
+        ac.cpu_checkpointing = checkpoint_in_cpu
+    if profile is not None:
+        ac.profile = profile
+    with _LOCK:
+        _CONFIG = _ActCkptState(ac, remat)
+    return _CONFIG
+
+
+def is_configured() -> bool:
+    return _CONFIG is not None
+
+
+def reset():
+    global _CONFIG
+    with _LOCK:
+        _CONFIG = None
+
+
+def checkpoint(function: Callable, *args, policy=None, static_argnums=(),
+               prevent_cse: bool = False, **kwargs) -> Any:
+    """Run ``function`` under remat (reference ``CheckpointFunction.apply``).
+
+    Unlike the reference this returns the value of a *traced, differentiable*
+    call: ``jax.grad`` through it recomputes the forward instead of reading
+    stored activations.
+    """
+    state = _CONFIG or _ActCkptState()
+    fn = jax.checkpoint(
+        function,
+        policy=policy if policy is not None else state.policy,
+        prevent_cse=prevent_cse,
+        static_argnums=static_argnums,
+    )
+    return fn(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable, policy=None,
+                       prevent_cse: bool = False,
+                       static_argnums=()) -> Callable:
+    """Decorator form: returns a remat-wrapped callable honoring the
+    configured policy at call time."""
+
+    def wrapped(*args, **kwargs):
+        return checkpoint(function, *args, policy=policy,
+                          prevent_cse=prevent_cse,
+                          static_argnums=static_argnums, **kwargs)
+
+    return wrapped
+
+
+# ``CheckpointFunction`` in the reference is a torch.autograd.Function; here
+# the callable itself is the whole mechanism.
+CheckpointFunction = checkpoint_wrapper
+
+
+# ---------------------------------------------------------------------------
+# RNG tracker (reference checkpointing.py:122-241 Megatron CudaRNGStatesTracker)
+# ---------------------------------------------------------------------------
+class RNGStateTracker:
+    """Named deterministic RNG streams for porting Megatron-style code.
+
+    JAX RNG is functional, so "checkpoint and restore generator state" is
+    simply "re-split the same key": forked streams are reproducible by
+    construction, which is exactly the property the reference's state
+    save/restore machinery exists to guarantee.
+    """
+
+    def __init__(self):
+        self._keys = {}
+        self._counts = {}
+
+    def add(self, name: str, seed_or_key):
+        if name in self._keys:
+            raise ValueError(f"rng state {name!r} already added")
+        key = (jax.random.PRNGKey(seed_or_key)
+               if isinstance(seed_or_key, int) else seed_or_key)
+        self._keys[name] = key
+        self._counts[name] = 0
+
+    def get_states(self):
+        return dict(self._keys), dict(self._counts)
+
+    def set_states(self, states):
+        self._keys, self._counts = dict(states[0]), dict(states[1])
+
+    def fork(self, name: str = "model-parallel-rng"):
+        """Next key in the named stream (call-counted, deterministic)."""
+        if name not in self._keys:
+            raise KeyError(f"rng state {name!r} was never added")
+        count = self._counts[name]
+        self._counts[name] = count + 1
+        return jax.random.fold_in(self._keys[name], count)
+
+    def reset(self):
+        self._keys.clear()
+        self._counts.clear()
+
+
+_RNG_TRACKER = RNGStateTracker()
+
+
+def get_rng_tracker() -> RNGStateTracker:
+    return _RNG_TRACKER
+
+
+def model_parallel_reconfigure(seed: int,
+                               tp_rank: Optional[int] = None) -> None:
+    """Seed the tracker with per-TP-rank decorrelated streams (reference
+    ``model_parallel_cuda_manual_seed``): same ``seed`` everywhere, dropout
+    stream offset by the tensor-parallel coordinate."""
+    _RNG_TRACKER.reset()
+    base = jax.random.PRNGKey(seed)
+    _RNG_TRACKER.add("default", base)
+    mp_key = jax.random.fold_in(base, 2718 + (tp_rank or 0))
+    _RNG_TRACKER.add("model-parallel-rng", mp_key)

@@ -244,6 +244,14 @@ class DeepSpeedEngine:
 
         self.monitor = self._configure_monitor()
 
+        # module-level activation checkpointing (reference engine.py:818
+        # _configure_checkpointing): models that call
+        # activation_checkpointing.checkpoint() pick up this policy
+        from deepspeed_tpu.runtime import activation_checkpointing
+        activation_checkpointing.configure(
+            self._config, remat=self._config.tpu.remat
+            if self._config.tpu.remat != "none" else "full")
+
         # compiled fns (built on first use)
         self._fwd_bwd_fn = None
         self._apply_fn = None
