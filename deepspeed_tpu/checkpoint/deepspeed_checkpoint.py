@@ -12,14 +12,9 @@ import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
-from flax import serialization, traverse_util
+from flax import serialization
 
-
-def _flatten(tree: Dict[str, Any]) -> Dict[str, np.ndarray]:
-    return {
-        ".".join(k): v
-        for k, v in traverse_util.flatten_dict(tree).items()
-    }
+from deepspeed_tpu.utils.tree import flatten_dots as _flatten
 
 
 class DeepSpeedCheckpoint:

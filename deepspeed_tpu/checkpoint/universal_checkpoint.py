@@ -61,7 +61,16 @@ def convert_to_universal(ckpt_dir: str, out_dir: str,
                 pname = key.split(marker, 1)[1]
                 moments.setdefault(pname, {})[moment] = arr
 
-    manifest = {"tag": str(ds.tag), "parameters": {}}
+    # optax step counter (ScaleByAdamState.count): needed so bias
+    # correction resumes at the right step, not at the fresh engine's
+    step_count = None
+    for key, arr in optim.items():
+        if key == "count" or key.endswith(".count"):
+            step_count = int(np.asarray(arr))
+            break
+
+    manifest = {"tag": str(ds.tag), "parameters": {},
+                "step_count": step_count}
     for name, arr in module.items():
         pdir = _param_dir(out_dir, name)
         os.makedirs(pdir, exist_ok=True)
@@ -120,7 +129,9 @@ def load_universal_into_engine(engine, universal_dir: str,
             raise ValueError(
                 f"shape mismatch for {name}: checkpoint {arr.shape} vs "
                 f"model {np.shape(cur)}")
-        flat[path] = arr.astype(np.asarray(cur).dtype)
+        # .dtype attr, never np.asarray: leaves may be sharded jax.Arrays
+        # spanning non-addressable devices on multi-host meshes
+        flat[path] = arr.astype(getattr(cur, "dtype", np.float32))
         loaded += 1
     restored = serialization.from_state_dict(
         engine._params, traverse_util.unflatten_dict(flat))
@@ -128,12 +139,20 @@ def load_universal_into_engine(engine, universal_dir: str,
         lambda t: t, out_shardings=engine._param_shardings)(restored)
 
     if load_optimizer_states and engine._opt_state is not None:
+        with open(os.path.join(universal_dir,
+                               "universal_manifest.json")) as f:
+            step_count = json.load(f).get("step_count")
         opt_sd = serialization.to_state_dict(engine._opt_state)
         opt_flat = _flat(opt_sd)
         for path, cur in opt_flat.items():
             if cur is traverse_util.empty_node:
                 continue
             key = ".".join(path)
+            if step_count is not None and (key == "count"
+                                           or key.endswith(".count")):
+                opt_flat[path] = np.asarray(
+                    step_count, dtype=getattr(cur, "dtype", np.int32))
+                continue
             for tag_name, moment in (("mu", "exp_avg"), ("nu", "exp_avg_sq")):
                 marker = f".{tag_name}."
                 if marker in key:
@@ -141,7 +160,8 @@ def load_universal_into_engine(engine, universal_dir: str,
                     if pname in state and moment in state[pname]:
                         arr = state[pname][moment]
                         opt_flat[path] = arr.astype(
-                            np.asarray(cur).dtype).reshape(np.shape(cur))
+                            getattr(cur, "dtype", np.float32)).reshape(
+                                np.shape(cur))
         restored_opt = serialization.from_state_dict(
             engine._opt_state, traverse_util.unflatten_dict(opt_flat))
         engine._opt_state = jax.jit(

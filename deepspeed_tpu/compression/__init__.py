@@ -1,0 +1,18 @@
+"""Compression training (reference ``deepspeed/compression/``): quantize-
+aware training, structured/unstructured pruning, layer reduction — as pure
+pytree transforms applied at step boundaries or in-forward with an STE."""
+
+from deepspeed_tpu.compression.compress import (  # noqa: F401
+    Compressor,
+    init_compression,
+    redundancy_clean,
+)
+from deepspeed_tpu.compression.config import (  # noqa: F401
+    CompressionGroup,
+    LayerReductionConfig,
+    parse_compression_config,
+)
+from deepspeed_tpu.compression.scheduler import (  # noqa: F401
+    CompressionScheduler,
+)
+from deepspeed_tpu.compression import functional  # noqa: F401

@@ -1,0 +1,150 @@
+"""Compression driver over param pytrees.
+
+Reference ``compression/compress.py:97`` (init_compression) walks nn.Module
+trees replacing layers with *_Compress variants; ``redundancy_clean``
+(:127) then physically shrinks pruned layers. TPU re-design: compression is
+a pytree transform — ``Compressor.apply(params, step)`` fake-quantizes /
+masks matching parameters at step boundaries (the MoQ pattern,
+reference runtime/quantize.py), and ``redundancy_clean`` rewrites the
+pytree with physically smaller arrays, fixing up consumers listed in
+``related_modules``.
+"""
+
+import fnmatch
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression import functional as F
+from deepspeed_tpu.utils.tree import flatten_dots, unflatten_dots
+from deepspeed_tpu.compression.config import (
+    CompressionGroup,
+    LayerReductionConfig,
+    parse_compression_config,
+)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+from deepspeed_tpu.utils.logging import logger
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    return any(
+        fnmatch.fnmatch(path, pat) or fnmatch.fnmatch(path, f"*{pat}*")
+        for pat in patterns)
+
+
+class Compressor:
+    """Holds parsed groups + scheduler; applies techniques to params."""
+
+    def __init__(self, ds_config: Dict[str, Any]):
+        self.groups, self.layer_reduction = \
+            parse_compression_config(ds_config)
+        self.scheduler = CompressionScheduler(self.groups)
+
+    def enabled(self) -> bool:
+        return bool(self.groups)
+
+    # ------------------------------------------------------------------
+    def apply(self, params, step: int,
+              key: Optional[jax.Array] = None):
+        """Return params with every active technique applied (STE-free —
+        use at step boundaries; for in-forward QAT wrap weights with
+        functional.ste)."""
+        if not self.groups:
+            return params
+        flat = flatten_dots(params)
+        for gi, group in enumerate(self.groups):
+            if not self.scheduler.is_active(group, step):
+                continue
+            for name in list(flat):
+                if not _match(name, group.modules):
+                    continue
+                w = flat[name]
+                if not hasattr(w, "ndim") or w.ndim < 2:
+                    continue  # techniques act on matrices, not biases
+                subkey = (jax.random.fold_in(key, gi)
+                          if key is not None else None)
+                flat[name] = self._apply_one(group, w, step, subkey)
+        return unflatten_dots(flat)
+
+    def _apply_one(self, group: CompressionGroup, w, step: int, key):
+        t = group.technique
+        p = group.params
+        if t == "weight_quantization":
+            bits = self.scheduler.current_bits(group, step)
+            return F.quantize_weight(
+                w, bits,
+                group.shared.get("quantization_type", "symmetric"),
+                group.shared.get("rounding", "nearest"),
+                int(group.shared.get("quantize_groups", 1)),
+                key=key)
+        if t == "activation_quantization":
+            return w  # applied in-forward, not on the param tree
+        if t == "sparse_pruning":
+            return w * F.sparse_pruning_mask(
+                w, float(p.get("dense_ratio", 0.5)),
+                group.shared.get("method", "l1"))
+        if t == "row_pruning":
+            return w * F.row_pruning_mask(
+                w, float(p.get("dense_ratio", 0.5)),
+                group.shared.get("method", "l1"))
+        if t == "head_pruning":
+            return w * F.head_pruning_mask(
+                w, int(p.get("num_heads", 1)),
+                float(p.get("dense_ratio", 0.5)))
+        if t == "channel_pruning":
+            return w * F.channel_pruning_mask(
+                w, float(p.get("dense_ratio", 0.5)),
+                group.shared.get("method", "l1"))
+        raise ValueError(f"unknown technique {t}")
+
+
+def init_compression(ds_config: Dict[str, Any]) -> Compressor:
+    """Build a Compressor from a DeepSpeed-style config dict
+    (reference compress.py:97 — there it mutates the model in place; here
+    it returns the transform object)."""
+    c = Compressor(ds_config)
+    if c.enabled():
+        logger.info(
+            f"compression enabled: "
+            f"{[f'{g.technique}/{g.name}' for g in c.groups]}")
+    return c
+
+
+def redundancy_clean(params, ds_config: Dict[str, Any]):
+    """Physically remove pruned rows/channels (reference compress.py:127).
+
+    For each row-pruning group, output neurons (last axis of the flax
+    [in..., out] kernel) that are entirely zero are dropped, along with the
+    matching bias entries; consumers named in ``related_modules`` get the
+    matching INPUT rows (axis 0) dropped. Returns the new (smaller) pytree.
+    """
+    groups, _ = parse_compression_config(ds_config)
+    flat = {k: np.asarray(v) for k, v in flatten_dots(params).items()}
+    for group in groups:
+        if group.technique != "row_pruning":
+            continue
+        for name in list(flat):
+            if not _match(name, group.modules):
+                continue
+            w = flat[name]
+            if w.ndim < 2:
+                continue
+            keep = np.abs(w).sum(axis=tuple(range(w.ndim - 1))) > 0
+            if keep.all():
+                continue
+            flat[name] = w[..., keep]
+            # shrink the bias alongside its kernel
+            bias_name = name.rsplit(".", 1)[0] + ".bias"
+            if bias_name in flat and flat[bias_name].shape[0] == keep.size:
+                flat[bias_name] = flat[bias_name][keep]
+            for rel in group.related_modules:
+                for rname in flat:
+                    if _match(rname, [rel]) and flat[rname].ndim >= 2 and \
+                            flat[rname].shape[0] == keep.size:
+                        flat[rname] = flat[rname][keep]
+            logger.info(
+                f"redundancy_clean: {name} {w.shape} -> "
+                f"{flat[name].shape}")
+    return unflatten_dots({k: jnp.asarray(v) for k, v in flat.items()})

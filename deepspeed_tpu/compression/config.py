@@ -1,0 +1,95 @@
+"""Compression config parsing (reference ``compression/config.py``, 490 LoC).
+
+Block shape (reference constants.py)::
+
+    "compression_training": {
+      "weight_quantization": {
+        "shared_parameters": {"enabled": .., "quantizer_kernel": ..,
+          "schedule_offset": .., "quantize_groups": .., "quantize_verbose": ..,
+          "quantization_type": "symmetric|asymmetric",
+          "rounding": "nearest|stochastic", "quantize_weight_in_forward": ..,
+          "fp16_mixed_quantize": {...}},
+        "different_groups": {
+          "group_name": {"params": {"start_bits": 8, "target_bits": 4,
+                                    "quantization_period": 50},
+                         "modules": ["attention.self", "*"],
+                         "related_modules": [...]}}},
+      "activation_quantization": {...},
+      "sparse_pruning": {...}, "row_pruning": {...},
+      "head_pruning": {...}, "channel_pruning": {...},
+      "layer_reduction": {...}
+    }
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+TECHNIQUES = (
+    "weight_quantization",
+    "activation_quantization",
+    "sparse_pruning",
+    "row_pruning",
+    "head_pruning",
+    "channel_pruning",
+)
+
+
+@dataclasses.dataclass
+class CompressionGroup:
+    """One different_groups entry of one technique."""
+
+    technique: str
+    name: str
+    params: Dict[str, Any]
+    modules: List[str]
+    related_modules: List[str]
+    shared: Dict[str, Any]
+
+    @property
+    def schedule_offset(self) -> int:
+        return int(self.shared.get("schedule_offset", 0))
+
+
+@dataclasses.dataclass
+class LayerReductionConfig:
+    enabled: bool = False
+    keep_number_layer: Optional[int] = None
+    module_name_prefix: str = ""
+    teacher_layer: Optional[List[int]] = None
+    other_module_name: Optional[List[str]] = None
+
+
+def parse_compression_config(ds_config: Dict[str, Any]) \
+        -> (List[CompressionGroup], LayerReductionConfig):
+    """Flatten the compression_training block into technique groups."""
+    block = ds_config.get("compression_training", {}) or {}
+    groups: List[CompressionGroup] = []
+    for technique in TECHNIQUES:
+        tech = block.get(technique)
+        if not tech:
+            continue
+        shared = tech.get("shared_parameters", {}) or {}
+        if not shared.get("enabled", False):
+            continue
+        diff = tech.get("different_groups", {}) or {}
+        if not diff:
+            raise ValueError(
+                f"{technique} enabled but has no different_groups")
+        for name, spec in diff.items():
+            groups.append(CompressionGroup(
+                technique=technique,
+                name=name,
+                params=dict(spec.get("params", {})),
+                modules=list(spec.get("modules", ["*"])),
+                related_modules=list(spec.get("related_modules", [])),
+                shared=shared,
+            ))
+    lr_block = block.get("layer_reduction", {}) or {}
+    layer_reduction = LayerReductionConfig(
+        enabled=lr_block.get("enabled", False),
+        keep_number_layer=lr_block.get("keep_number_layer"),
+        module_name_prefix=lr_block.get("module_name_prefix", ""),
+        teacher_layer=lr_block.get("teacher_layer"),
+        other_module_name=lr_block.get("other_module_name"),
+    )
+    return groups, layer_reduction

@@ -1,0 +1,157 @@
+"""Compression primitives as pure array functions.
+
+The reference implements these as stateful layer wrappers
+(``compression/basic_layer.py:61-877`` LinearLayer_Compress et al). The
+TPU-native form is pure functions over weights — applied either inside the
+forward (QAT with a straight-through estimator) or at step boundaries on
+the param pytree. All return arrays the same shape as the input; physical
+shrinking happens later in ``redundancy_clean``.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ste(w: jnp.ndarray, w_compressed: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through estimator: forward sees the compressed value,
+    backward sees identity."""
+    return w + jax.lax.stop_gradient(w_compressed - w)
+
+
+# ---------------------------------------------------------------------------
+# quantization (reference basic_layer Quantizer paths)
+# ---------------------------------------------------------------------------
+def quantize_weight(w: jnp.ndarray, bits: int,
+                    quantization_type: str = "symmetric",
+                    rounding: str = "nearest",
+                    num_groups: int = 1,
+                    key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Fake-quantize to ``bits`` with per-group scaling.
+
+    Groups split the flattened weight evenly (reference quantize_groups);
+    symmetric uses a max-abs scale, asymmetric a min/max affine range.
+    Stochastic rounding needs ``key``.
+    """
+    if bits >= 32:
+        return w
+    orig_shape = w.shape
+    flat = w.reshape(num_groups, -1)
+    levels = 2 ** bits
+
+    if bits == 1:
+        # binary quantization: sign * per-group mean magnitude (symmetric
+        # scale would divide by zero levels)
+        scale = jnp.mean(jnp.abs(flat), axis=-1, keepdims=True)
+        out = jnp.where(flat >= 0, scale, -scale)
+        return out.reshape(orig_shape).astype(w.dtype)
+
+    if quantization_type == "symmetric":
+        scale = jnp.max(jnp.abs(flat), axis=-1, keepdims=True)
+        scale = jnp.where(scale == 0, 1.0, scale) / (levels // 2 - 1)
+        q = flat / scale
+        zero = 0.0
+    elif quantization_type == "asymmetric":
+        lo = jnp.min(flat, axis=-1, keepdims=True)
+        hi = jnp.max(flat, axis=-1, keepdims=True)
+        scale = jnp.where(hi == lo, 1.0, (hi - lo) / (levels - 1))
+        zero = lo
+        q = (flat - zero) / scale
+    else:
+        raise ValueError(
+            f"quantization_type must be symmetric|asymmetric, got "
+            f"{quantization_type!r}")
+
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("stochastic rounding requires a PRNG key")
+        noise = jax.random.uniform(key, q.shape) - 0.5
+        q = jnp.round(q + noise)
+    elif rounding == "nearest":
+        q = jnp.round(q)
+    else:
+        raise ValueError(f"rounding must be nearest|stochastic, got "
+                         f"{rounding!r}")
+
+    if quantization_type == "symmetric":
+        q = jnp.clip(q, -(levels // 2 - 1), levels // 2 - 1)
+        out = q * scale
+    else:
+        q = jnp.clip(q, 0, levels - 1)
+        out = q * scale + zero
+    return out.reshape(orig_shape).astype(w.dtype)
+
+
+def quantize_activation(x: jnp.ndarray, bits: int,
+                        quantization_type: str = "symmetric",
+                        range_calibration: str = "dynamic") -> jnp.ndarray:
+    """Activation fake-quant with STE (reference activation_quantization);
+    dynamic range per tensor."""
+    del range_calibration  # static calibration needs running stats; dynamic only
+    return ste(x, quantize_weight(x, bits, quantization_type))
+
+
+# ---------------------------------------------------------------------------
+# pruning (reference sparse/row/head/channel pruning)
+# ---------------------------------------------------------------------------
+def _topk_mask(scores: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    k = max(int(round(scores.size * dense_ratio)), 1)
+    flat = scores.reshape(-1)
+    thresh = jnp.sort(flat)[-k]
+    return (scores >= thresh).astype(scores.dtype)
+
+
+def sparse_pruning_mask(w: jnp.ndarray, dense_ratio: float,
+                        method: str = "l1") -> jnp.ndarray:
+    """Elementwise keep-mask retaining ``dense_ratio`` of weights."""
+    if method not in ("l1", "topk"):
+        raise ValueError(f"sparse pruning method must be l1|topk, got "
+                         f"{method!r}")
+    return _topk_mask(jnp.abs(w), dense_ratio)
+
+
+def row_pruning_mask(w: jnp.ndarray, dense_ratio: float,
+                     method: str = "l1") -> jnp.ndarray:
+    """Output-neuron keep-mask. Flax kernels are [in..., out], so "rows" in
+    the reference's torch [out, in] sense live on the LAST axis here; the
+    mask is [1, ..., out] and a consumer layer loses the matching INPUT
+    rows (axis 0) in redundancy_clean."""
+    if method not in ("l1", "topk"):
+        raise ValueError(f"row pruning method must be l1|topk, got "
+                         f"{method!r}")
+    scores = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    return _topk_mask(scores, dense_ratio).reshape(
+        *([1] * (w.ndim - 1)), -1)
+
+
+def head_pruning_mask(w: jnp.ndarray, num_heads: int,
+                      dense_ratio: float) -> jnp.ndarray:
+    """Head keep-mask for an attention OUTPUT projection whose input dim
+    (axis 0 of a flax [n_embd, out] kernel) is ``num_heads * head_dim`` —
+    matching the reference, which prunes heads at the attn-output boundary.
+    Returns a full-shape 0/1 mask."""
+    rows = w.shape[0]
+    if rows % num_heads:
+        raise ValueError(
+            f"leading dim {rows} not divisible by num_heads {num_heads}")
+    per_head = w.reshape(num_heads, -1)
+    scores = jnp.sum(jnp.abs(per_head), axis=-1)
+    keep = _topk_mask(scores, dense_ratio)
+    return jnp.repeat(keep, rows // num_heads).reshape(
+        rows, *([1] * (w.ndim - 1))) * jnp.ones_like(w)
+
+
+def channel_pruning_mask(w: jnp.ndarray, dense_ratio: float,
+                         method: str = "l1") -> jnp.ndarray:
+    """Input-channel keep-mask: flax convs are [spatial..., in, out], so
+    input channels are axis -2. Mask shape is [..., in, 1]."""
+    if method not in ("l1", "topk"):
+        raise ValueError(f"channel pruning method must be l1|topk, got "
+                         f"{method!r}")
+    if w.ndim < 2:
+        raise ValueError("channel pruning needs a >=2-D kernel")
+    axes = tuple(range(w.ndim - 2)) + (w.ndim - 1,)
+    scores = jnp.sum(jnp.abs(w), axis=axes)
+    return _topk_mask(scores, dense_ratio).reshape(
+        *([1] * (w.ndim - 2)), -1, 1)

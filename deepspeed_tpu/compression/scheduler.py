@@ -1,0 +1,44 @@
+"""Compression scheduling (reference ``compression/scheduler.py``).
+
+Each technique group activates at its ``schedule_offset`` step; weight
+quantization additionally anneals from ``start_bits`` to ``target_bits``
+by halving every ``quantization_period`` steps after activation (the
+reference's progressive MoQ-style bit schedule).
+"""
+
+from typing import List
+
+from deepspeed_tpu.compression.config import CompressionGroup
+
+
+class CompressionScheduler:
+    def __init__(self, groups: List[CompressionGroup]):
+        self.groups = groups
+
+    def is_active(self, group: CompressionGroup, step: int) -> bool:
+        return step >= group.schedule_offset
+
+    def current_bits(self, group: CompressionGroup, step: int) -> int:
+        p = group.params
+        start = int(p.get("start_bits", 8))
+        target = int(p.get("target_bits", start))
+        period = max(int(p.get("quantization_period", 1)), 1)
+        if not self.is_active(group, step):
+            return 32
+        halvings = (step - group.schedule_offset) // period
+        bits = start
+        for _ in range(halvings):
+            if bits <= target:
+                break
+            bits = max(bits // 2, target)
+        return max(bits, target)
+
+    def describe(self, step: int) -> str:
+        lines = []
+        for g in self.groups:
+            state = "active" if self.is_active(g, step) else "pending"
+            extra = ""
+            if g.technique == "weight_quantization":
+                extra = f" bits={self.current_bits(g, step)}"
+            lines.append(f"{g.technique}/{g.name}: {state}{extra}")
+        return "\n".join(lines)

@@ -1,0 +1,19 @@
+"""Batch-size elasticity (reference ``deepspeed/elasticity/``): restart a
+job at any chip count in a precomputed envelope with the identical global
+batch. On TPU this pairs with slice resize/preemption restart; the
+torch-elastic agent has no analogue (the launcher re-execs instead)."""
+
+from deepspeed_tpu.elasticity.config import (  # noqa: F401
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.elasticity.elasticity import (  # noqa: F401
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+    highly_composite_numbers,
+)

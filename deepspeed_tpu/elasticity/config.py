@@ -1,0 +1,100 @@
+"""Elasticity config (reference ``deepspeed/elasticity/config.py``).
+
+Keys keep the reference names (``min_gpus``/``max_gpus``/
+``num_gpus_per_node``) so existing configs parse unchanged; on TPU they
+count chips and chips-per-host. ``min_chips``/``max_chips``/
+``num_chips_per_host`` are accepted as aliases.
+"""
+
+from typing import Any, Dict
+
+
+class ElasticityError(Exception):
+    """Base error for elasticity module."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Elasticity configuration error."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size incompatible with the given elastic config."""
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+
+
+class ElasticityConfig:
+    """Parsed elasticity block::
+
+        "elasticity": {
+            "enabled": true,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4, 6],
+            "min_gpus": 1,
+            "max_gpus": 10000,
+            "min_time": 20,
+            "version": 0.2,
+            "ignore_non_elastic_batch_info": false,
+            "prefer_larger_batch": true,
+            "model_parallel_size": 1,
+            "num_gpus_per_node": 1
+        }
+    """
+
+    def __init__(self, param_dict: Dict[str, Any]):
+        self.enabled = param_dict.get("enabled", False)
+        if self.enabled:
+            if "max_train_batch_size" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing max_train_batch_size")
+            if "micro_batch_sizes" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing micro_batch_sizes")
+        self.max_acceptable_batch_size = param_dict.get(
+            "max_train_batch_size", 2000)
+        self.micro_batches = param_dict.get("micro_batch_sizes", [2, 4, 6])
+
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be a list, got "
+                f"{type(self.micro_batches).__name__}")
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got "
+                f"{self.micro_batches}")
+
+        self.min_gpus = param_dict.get(
+            "min_chips", param_dict.get("min_gpus", 1))
+        self.max_gpus = param_dict.get(
+            "max_chips", param_dict.get("max_gpus", 10000))
+        if self.min_gpus < 1 or self.max_gpus < 1:
+            raise ElasticityConfigError("min/max chip counts must be >= 1")
+        if self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"max ({self.max_gpus}) < min ({self.min_gpus}) chip count")
+
+        self.model_parallel_size = param_dict.get("model_parallel_size", 1)
+        self.num_gpus_per_node = param_dict.get(
+            "num_chips_per_host", param_dict.get("num_gpus_per_node", 1))
+        if self.model_parallel_size < 1 or self.num_gpus_per_node < 1:
+            raise ElasticityConfigError(
+                "model_parallel_size and chips-per-host must be >= 1")
+
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = param_dict.get("version", 0.2)
+        self.prefer_larger_batch_size = param_dict.get(
+            "prefer_larger_batch", True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+
+    def repr_dict(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "max_train_batch_size": self.max_acceptable_batch_size,
+            "micro_batch_sizes": self.micro_batches,
+            "min_gpus": self.min_gpus,
+            "max_gpus": self.max_gpus,
+            "version": self.version,
+        }

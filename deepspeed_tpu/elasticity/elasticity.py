@@ -1,0 +1,248 @@
+"""Batch-size elasticity solver (reference ``elasticity/elasticity.py:125-380``).
+
+Picks one global train batch size <= the user's maximum that is compatible
+with the largest possible set of chip counts, so a job can restart at a
+different world size (slice resize, preemption) with the *identical*
+effective batch — convergence-neutral elasticity via gradient accumulation:
+``batch = micro_batch * grad_accum * dp_world``.
+
+The math is hardware-agnostic; v0.2 adds host granularity (chips-per-host)
+and model parallelism, where the schedulable unit is a host and the data-
+parallel world is ``chips / model_parallel_size``.
+"""
+
+import json
+import math
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.elasticity.config import (
+    DEEPSPEED_ELASTICITY_CONFIG,
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    LATEST_ELASTICITY_VERSION,
+)
+from deepspeed_tpu.utils.logging import logger
+
+_HCN_CACHE: List[int] = []
+
+
+def highly_composite_numbers(limit: int) -> List[int]:
+    """Record-setting divisor counts up to ``limit`` (computed via divisor
+    sieve, cached). These make the best batch multipliers: maximally many
+    chip counts divide them."""
+    global _HCN_CACHE
+    if _HCN_CACHE and _HCN_CACHE[-1] >= limit:
+        return [h for h in _HCN_CACHE if h <= limit]
+    limit = max(limit, 1)
+    counts = np.zeros(limit + 1, dtype=np.int32)
+    for d in range(1, limit + 1):
+        counts[d::d] += 1
+    hcns, best = [], 0
+    for n in range(1, limit + 1):
+        if counts[n] > best:
+            hcns.append(n)
+            best = counts[n]
+    _HCN_CACHE = hcns
+    return hcns
+
+
+def get_candidate_batch_sizes(base_list: List[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """For each base (micro-batch or their lcm), the largest
+    highly-composite multiple of it within the cap."""
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+            continue
+        hcns = highly_composite_numbers(max_acceptable_batch_size // base)
+        candidates.add(hcns[-1] * base)
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """Chip counts g such that some micro-batch evenly decomposes
+    ``batch_size = mb * gas * g``."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        per_mb = batch_size // mb
+        # g must divide batch/mb
+        for g in range(1, int(math.isqrt(per_mb)) + 1):
+            if per_mb % g == 0:
+                for cand in (g, per_mb // g):
+                    if min_valid_gpus <= cand <= max_valid_gpus:
+                        valid.add(cand)
+    return sorted(valid)
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                             min_gpus=1, max_gpus=10000,
+                             prefer_larger=True) -> Tuple[int, List[int]]:
+    lcm = int(np.lcm.reduce(np.array(micro_batches, dtype=np.int64)))
+    base_list = list(micro_batches) + [lcm]
+    candidates = get_candidate_batch_sizes(base_list,
+                                           max_acceptable_batch_size)
+    final_batch, best_gpus = 0, []
+    for batch in candidates:
+        gpus = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        better = len(gpus) > len(best_gpus) or (
+            len(gpus) == len(best_gpus)
+            and ((prefer_larger and batch > final_batch)
+                 or (not prefer_larger and batch < final_batch)))
+        if better:
+            final_batch, best_gpus = batch, gpus
+    return final_batch, best_gpus
+
+
+def _get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size,
+                             current_num_gpus, min_gpus=1, max_gpus=10000,
+                             prefer_larger=True, num_gpus_per_node=1,
+                             model_parallel_size=1):
+    if num_gpus_per_node % model_parallel_size != 0:
+        raise ElasticityError(
+            f"chips per host ({num_gpus_per_node}) must be divisible by "
+            f"model parallel size ({model_parallel_size})")
+    dp_per_node = num_gpus_per_node // model_parallel_size
+
+    def pick_microbatch(batch, dp_world):
+        chosen = None
+        dp_world = max(dp_world, 1)
+        for mb in micro_batches:
+            if (batch // dp_world) % mb == 0:
+                if chosen is None or (prefer_larger and mb > chosen):
+                    chosen = mb
+        return chosen
+
+    # schedule at host granularity: solve v0.1 in units of hosts
+    batch_per_node, valid_nodes = _get_compatible_gpus_v01(
+        micro_batches,
+        max(max_acceptable_batch_size // dp_per_node, 1),
+        max(min_gpus // num_gpus_per_node, 1),
+        max(max_gpus // num_gpus_per_node, 1),
+        prefer_larger=prefer_larger)
+    final_batch = int(batch_per_node) * dp_per_node
+    valid_dp_worlds = [n * dp_per_node for n in valid_nodes]
+
+    if current_num_gpus // model_parallel_size in valid_dp_worlds:
+        return final_batch, valid_dp_worlds, pick_microbatch(
+            final_batch, current_num_gpus // model_parallel_size)
+
+    # current world not in the envelope: best batch for this exact world
+    current_dp = (current_num_gpus // num_gpus_per_node) * dp_per_node
+    current_dp = max(current_dp, 1)
+    per_mb = [mb * current_dp * (max_acceptable_batch_size
+                                 // (mb * current_dp))
+              for mb in micro_batches if mb * current_dp
+              <= max_acceptable_batch_size]
+    if not per_mb:
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro batch fits world {current_num_gpus} under batch cap "
+            f"{max_acceptable_batch_size}")
+    batch = max(per_mb) if prefer_larger else min(per_mb)
+    # validate the micro batch against the dp world actually returned
+    return batch, [current_dp], pick_microbatch(batch, current_dp)
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return ds_config.get("elasticity", {}).get("enabled", False)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
+    """Cross-check the runtime elastic config against the one the resource
+    scheduler saw (via env), reference elasticity.py:256."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            "DEEPSPEED_ELASTICITY_CONFIG not set; cannot guarantee resource "
+            "scheduler uses a compatible chip-count envelope")
+        return
+    sched = ElasticityConfig(
+        json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+    run = ElasticityConfig(runtime_elastic_config_dict)
+    for attr in ("max_acceptable_batch_size", "micro_batches", "version"):
+        if getattr(sched, attr) != getattr(run, attr):
+            raise ElasticityConfigError(
+                f"elastic config mismatch on {attr}: scheduler "
+                f"{getattr(sched, attr)} vs runtime {getattr(run, attr)}")
+
+
+def compute_elastic_config(ds_config: dict,
+                           target_deepspeed_version: Optional[str] = None,
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Compute (final_batch_size, valid_chip_counts[, micro_batch]).
+
+    Given the elastic envelope config, returns one deterministic global
+    batch size and every chip count it can run at. With ``world_size`` (or
+    env WORLD_SIZE) also validates the current world and optionally returns
+    the micro-batch to use there.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(
+            f"expected ds_config dict, got {type(ds_config).__name__}")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError(
+            "'elasticity' is missing from the config json")
+    elastic_dict = ds_config["elasticity"]
+    if not elastic_dict.get("enabled", False):
+        raise ElasticityConfigError(
+            "elasticity is disabled; set 'enabled': true")
+    cfg = ElasticityConfig(elastic_dict)
+
+    if cfg.model_parallel_size > 1 and float(cfg.version) != 0.2:
+        raise ElasticityConfigError(
+            f"elasticity v{cfg.version} does not support model parallelism")
+    if float(cfg.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity v{cfg.version} not supported (latest "
+            f"{LATEST_ELASTICITY_VERSION})")
+
+    if world_size == 0 and os.getenv("WORLD_SIZE", "").isnumeric():
+        world_size = int(os.environ["WORLD_SIZE"])
+
+    micro_batch = None
+    if float(cfg.version) == 0.1:
+        final_batch, valid_gpus = _get_compatible_gpus_v01(
+            cfg.micro_batches, cfg.max_acceptable_batch_size,
+            cfg.min_gpus, cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size)
+    elif float(cfg.version) == 0.2:
+        if world_size == 0:
+            raise ElasticityConfigError(
+                "elasticity v0.2 needs the current world size (arg or "
+                "WORLD_SIZE env)")
+        final_batch, valid_gpus, micro_batch = _get_compatible_gpus_v02(
+            cfg.micro_batches, cfg.max_acceptable_batch_size, world_size,
+            cfg.min_gpus, cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch_size,
+            num_gpus_per_node=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
+    else:
+        raise ElasticityConfigError(f"unknown elasticity version "
+                                    f"{cfg.version}")
+
+    if world_size > 0 and float(cfg.version) == 0.1:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid chip counts "
+                f"{valid_gpus}")
+        if return_microbatch:
+            for mb in sorted(cfg.micro_batches,
+                             reverse=cfg.prefer_larger_batch_size):
+                if final_batch % (mb * world_size) == 0:
+                    micro_batch = mb
+                    break
+
+    logger.info(
+        f"elasticity: final_batch_size={final_batch}, "
+        f"valid chip counts={valid_gpus}")
+    if return_microbatch:
+        return final_batch, valid_gpus, micro_batch
+    return final_batch, valid_gpus

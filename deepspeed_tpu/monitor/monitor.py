@@ -1,0 +1,148 @@
+"""Experiment monitoring fan-out (reference ``deepspeed/monitor/monitor.py:24``).
+
+``MonitorMaster`` dispatches ``(tag, value, step)`` events to every enabled
+backend: TensorBoard (if the package is importable), Weights & Biases (if
+importable and logged in), and a dependency-free CSV writer. Events are
+written rank-0-only, matching the reference's ``rank == 0`` gating — here
+"rank 0" is jax.process_index() == 0 (multi-host) since within a process all
+devices see the same host Python.
+"""
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    """Backend interface (reference monitor/monitor.py Monitor ABC)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list: List[Event]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """reference monitor/tensorboard.py — needs tensorboardX or torch tb."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if not self.enabled or jax.process_index() != 0:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+            except ImportError:
+                logger.warning(
+                    "tensorboard requested but no SummaryWriter available")
+                return
+        log_dir = os.path.join(config.output_path or "./runs",
+                               config.job_name)
+        self.summary_writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, event_list: List[Event]):
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """reference monitor/wandb.py."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.wandb = None
+        if not self.enabled or jax.process_index() != 0:
+            return
+        try:
+            import wandb  # type: ignore
+        except ImportError:
+            logger.warning("wandb requested but not installed")
+            return
+        wandb.init(project=config.project, group=config.group,
+                   entity=config.team)
+        self.wandb = wandb
+
+    def write_events(self, event_list: List[Event]):
+        if self.wandb is None:
+            return
+        for tag, value, step in event_list:
+            self.wandb.log({tag: value}, step=step)
+
+
+class CsvMonitor(Monitor):
+    """reference monitor/csv_monitor.py — one csv file per event tag."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.log_dir = None
+        self._files = {}
+        if not self.enabled or jax.process_index() != 0:
+            return
+        self.log_dir = os.path.join(config.output_path or "./csv_logs",
+                                    config.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def _path(self, tag: str) -> str:
+        # tag components become path-safe file names (Train/loss -> Train_loss)
+        return os.path.join(self.log_dir,
+                            tag.replace("/", "_").replace(" ", "_") + ".csv")
+
+    def write_events(self, event_list: List[Event]):
+        if self.log_dir is None:
+            return
+        for tag, value, step in event_list:
+            path = self._path(tag)
+            new = not os.path.exists(path)
+            with open(path, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, value])
+
+    def close(self):
+        pass
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled backends (reference monitor/monitor.py:24)."""
+
+    def __init__(self, ds_config):
+        self.tb_monitor: Optional[TensorBoardMonitor] = None
+        self.wandb_monitor: Optional[WandbMonitor] = None
+        self.csv_monitor: Optional[CsvMonitor] = None
+        self.enabled = False
+
+        tb_cfg = getattr(ds_config, "tensorboard", None)
+        wandb_cfg = getattr(ds_config, "wandb", None)
+        csv_cfg = getattr(ds_config, "csv_monitor", None)
+        if jax.process_index() == 0:
+            if tb_cfg is not None and tb_cfg.enabled:
+                self.tb_monitor = TensorBoardMonitor(tb_cfg)
+                self.enabled = True
+            if wandb_cfg is not None and wandb_cfg.enabled:
+                self.wandb_monitor = WandbMonitor(wandb_cfg)
+                self.enabled = True
+            if csv_cfg is not None and csv_cfg.enabled:
+                self.csv_monitor = CsvMonitor(csv_cfg)
+                self.enabled = True
+
+    def write_events(self, event_list: List[Event]):
+        if jax.process_index() != 0:
+            return
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m is not None:
+                m.write_events(event_list)

@@ -249,8 +249,7 @@ class DeepSpeedEngine:
         # activation_checkpointing.checkpoint() pick up this policy
         from deepspeed_tpu.runtime import activation_checkpointing
         activation_checkpointing.configure(
-            self._config, remat=self._config.tpu.remat
-            if self._config.tpu.remat != "none" else "full")
+            self._config, remat=self._config.tpu.remat)
 
         # compiled fns (built on first use)
         self._fwd_bwd_fn = None

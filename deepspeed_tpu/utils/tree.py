@@ -23,3 +23,23 @@ def flatten_with_paths(tree) -> Dict[str, Any]:
     """Pytree -> {path_string: leaf}."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     return {path_str(path): leaf for path, leaf in flat}
+
+
+def flatten_dots(tree, keep_empty_nodes: bool = False) -> Dict[str, Any]:
+    """State-dict-style nested dict -> {'a.b.c': leaf} (flax traverse_util
+    flatten with dot-joined keys; the checkpoint/compression path scheme)."""
+    from flax import traverse_util
+
+    return {
+        ".".join(k): v
+        for k, v in traverse_util.flatten_dict(
+            tree, keep_empty_nodes=keep_empty_nodes).items()
+    }
+
+
+def unflatten_dots(flat: Dict[str, Any]):
+    """Inverse of :func:`flatten_dots`."""
+    from flax import traverse_util
+
+    return traverse_util.unflatten_dict(
+        {tuple(k.split(".")): v for k, v in flat.items()})

@@ -1,0 +1,240 @@
+"""Monitor, elasticity, and compression tests.
+
+Mirrors reference tests/unit/{monitor,elasticity,compression} coverage.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# ---------------------------------------------------------------------------
+# monitor
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.monitor import CsvMonitor, MonitorMaster
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+class TestMonitor:
+    def test_csv_monitor_writes(self, tmp_path):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 8,
+            "csv_monitor": {"enabled": True,
+                            "output_path": str(tmp_path),
+                            "job_name": "job"},
+        }, dp_world_size=1)
+        m = MonitorMaster(cfg)
+        assert m.enabled
+        m.write_events([("Train/loss", 1.5, 10), ("Train/loss", 1.2, 20),
+                        ("Train/lr", 0.1, 10)])
+        loss_csv = tmp_path / "job" / "Train_loss.csv"
+        lr_csv = tmp_path / "job" / "Train_lr.csv"
+        assert loss_csv.exists() and lr_csv.exists()
+        rows = loss_csv.read_text().strip().splitlines()
+        assert rows[0].startswith("step") and len(rows) == 3
+
+    def test_disabled_monitor_noop(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=1)
+        m = MonitorMaster(cfg)
+        assert not m.enabled
+        m.write_events([("x", 1.0, 1)])  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# elasticity
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    elasticity_enabled,
+    get_valid_gpus,
+    highly_composite_numbers,
+)
+
+
+def elastic_dict(**over):
+    base = {"enabled": True, "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4, 6], "min_gpus": 1,
+            "max_gpus": 10000, "version": 0.1}
+    base.update(over)
+    return {"elasticity": base}
+
+
+class TestElasticity:
+    def test_v01_canonical_example(self):
+        # the reference's documented example resolves to 1680
+        fb, gpus = compute_elastic_config(elastic_dict())
+        assert fb == 1680
+        assert gpus[0] == 1 and 840 in gpus
+        # every valid count decomposes the batch with some micro batch
+        for g in gpus:
+            assert any(fb % (mb * g) == 0 for mb in [2, 4, 6])
+
+    def test_valid_gpus_math(self):
+        gpus = get_valid_gpus(48, [2, 3], 1, 100)
+        for g in gpus:
+            assert 48 % (2 * g) == 0 or 48 % (3 * g) == 0
+        assert 24 in gpus and 16 in gpus
+
+    def test_world_size_check(self):
+        fb, gpus, mb = compute_elastic_config(
+            elastic_dict(), world_size=4, return_microbatch=True)
+        assert 4 in gpus and fb % (mb * 4) == 0
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(elastic_dict(max_train_batch_size=4,
+                                                micro_batch_sizes=[2]),
+                                   world_size=1000)
+
+    def test_v02_with_model_parallel(self):
+        fb, gpus, mb = compute_elastic_config(
+            elastic_dict(version=0.2, num_gpus_per_node=4,
+                         model_parallel_size=2),
+            world_size=8, return_microbatch=True)
+        assert fb > 0 and mb in [2, 4, 6]
+        # dp world = chips / mp
+        assert all(g % 2 == 0 for g in gpus)
+
+    def test_errors(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({"elasticity": {"enabled": False}})
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({})
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(elastic_dict(model_parallel_size=2))
+        assert not elasticity_enabled({})
+        assert elasticity_enabled(elastic_dict())
+
+    def test_hcn_generation(self):
+        hcns = highly_composite_numbers(1000)
+        assert hcns[:8] == [1, 2, 4, 6, 12, 24, 36, 48]
+        assert all(a < b for a, b in zip(hcns, hcns[1:]))
+
+
+# ---------------------------------------------------------------------------
+# compression
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.compression import (
+    functional as F,
+    init_compression,
+    redundancy_clean,
+)
+
+
+def compression_dict():
+    return {
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "quantization_type": "symmetric",
+                                      "rounding": "nearest",
+                                      "quantize_groups": 1,
+                                      "schedule_offset": 0},
+                "different_groups": {
+                    "wq": {"params": {"start_bits": 8, "target_bits": 4,
+                                      "quantization_period": 10},
+                           "modules": ["dense"]}},
+            },
+            "row_pruning": {
+                "shared_parameters": {"enabled": True, "method": "l1",
+                                      "schedule_offset": 5},
+                "different_groups": {
+                    "rp": {"params": {"dense_ratio": 0.5},
+                           "modules": ["mlp.w1"],
+                           "related_modules": ["mlp.w2"]}},
+            },
+        }
+    }
+
+
+class TestCompression:
+    def test_quantize_symmetric_levels(self):
+        w = jnp.linspace(-1, 1, 256).reshape(16, 16)
+        q = F.quantize_weight(w, 4)
+        # 4 bits symmetric -> at most 15 distinct levels
+        assert len(np.unique(np.asarray(q))) <= 15
+        np.testing.assert_allclose(np.asarray(q), np.asarray(w), atol=0.15)
+
+    def test_quantize_asymmetric_preserves_range(self):
+        w = jnp.linspace(0.5, 2.0, 64).reshape(8, 8)
+        q = F.quantize_weight(w, 8, "asymmetric")
+        assert abs(float(q.min()) - 0.5) < 1e-6
+        assert abs(float(q.max()) - 2.0) < 1e-6
+
+    def test_binary_quantization(self):
+        w = jnp.asarray(np.random.RandomState(3).randn(8, 8),
+                        dtype=jnp.float32)
+        q = F.quantize_weight(w, 1)
+        vals = np.unique(np.asarray(q))
+        assert len(vals) == 2 and vals[0] == -vals[1]
+        assert not np.isnan(np.asarray(q)).any()
+
+    def test_stochastic_rounding_unbiased(self):
+        w = jnp.full((4, 128), 0.3)
+        keys = jax.random.split(jax.random.PRNGKey(0), 50)
+        qs = [F.quantize_weight(w, 2, key=k, rounding="stochastic")
+              for k in keys]
+        assert abs(float(jnp.mean(jnp.stack(qs))) - 0.3) < 0.05
+        with pytest.raises(ValueError):
+            F.quantize_weight(w, 2, rounding="stochastic")  # no key
+
+    def test_pruning_masks(self):
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+        m = F.sparse_pruning_mask(w, 0.25)
+        assert abs(float(m.mean()) - 0.25) < 0.05
+        # flax [in=16, out=8]: row pruning acts on the output axis
+        rm = F.row_pruning_mask(w, 0.5)
+        assert rm.shape == (1, 8) and int(rm.sum()) == 4
+        hm = F.head_pruning_mask(w, num_heads=4, dense_ratio=0.5)
+        assert hm.shape == w.shape
+        # input channels = axis -2 -> 16
+        cm = F.channel_pruning_mask(w, 0.5)
+        assert cm.shape == (16, 1) and int(cm.sum()) == 8
+
+    def test_compressor_apply_and_schedule(self):
+        comp = init_compression(compression_dict())
+        assert comp.enabled()
+        rng = np.random.RandomState(1)
+        params = {
+            "dense": {"kernel": jnp.asarray(rng.randn(8, 8),
+                                            dtype=jnp.float32)},
+            "mlp": {"w1": {"kernel": jnp.asarray(rng.randn(8, 4),
+                                                 dtype=jnp.float32)}},
+        }
+        # step 0: quantization active at 8 bits, row pruning not yet
+        out0 = comp.apply(params, step=0)
+        assert len(np.unique(np.asarray(
+            out0["dense"]["kernel"]))) <= 2 ** 8
+        np.testing.assert_array_equal(
+            np.asarray(out0["mlp"]["w1"]["kernel"]),
+            np.asarray(params["mlp"]["w1"]["kernel"]))
+        # step 30: bits annealed 8 -> 4, row pruning active (50% rows zero)
+        g = comp.groups[0]
+        assert comp.scheduler.current_bits(g, 30) == 4
+        out30 = comp.apply(params, step=30)
+        w1 = np.asarray(out30["mlp"]["w1"]["kernel"])
+        # half the OUTPUT neurons (axis 1 of flax [in, out]) are zeroed
+        assert (np.abs(w1).sum(axis=0) == 0).sum() == 2
+
+    def test_redundancy_clean_shrinks(self):
+        rng = np.random.RandomState(2)
+        comp = init_compression(compression_dict())
+        # flax convention: w1 [in=4, out=8] feeds w2 [in=8, out=4]
+        params = {
+            "mlp": {
+                "w1": {"kernel": jnp.asarray(rng.randn(4, 8),
+                                             dtype=jnp.float32),
+                       "bias": jnp.asarray(rng.randn(8), jnp.float32)},
+                "w2": {"kernel": jnp.asarray(rng.randn(8, 4),
+                                             dtype=jnp.float32)},
+            },
+        }
+        pruned = comp.apply(params, step=100)
+        cleaned = redundancy_clean(pruned, compression_dict())
+        assert cleaned["mlp"]["w1"]["kernel"].shape == (4, 4)
+        assert cleaned["mlp"]["w1"]["bias"].shape == (4,)
+        # consumer loses the matching input rows
+        assert cleaned["mlp"]["w2"]["kernel"].shape == (4, 4)

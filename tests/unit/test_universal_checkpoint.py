@@ -73,6 +73,27 @@ class TestUniversalCheckpoint:
                                        np.asarray(fb[k]),
                                        rtol=1e-6, atol=1e-6)
 
+    def test_optimizer_step_count_restored(self, tmp_path, eight_devices):
+        engine, it = _engine()
+        for _ in range(5):
+            engine.train_batch(it)
+        ckpt = tmp_path / "ckpt"
+        engine.save_checkpoint(str(ckpt), tag="s5")
+        uni = tmp_path / "uni"
+        manifest = convert_to_universal(str(ckpt), str(uni), tag="s5")
+        assert manifest["step_count"] == 5
+
+        engine2, it2 = _engine()
+        engine2.train_batch(it2)  # count == 1
+        load_universal_into_engine(engine2, str(uni))
+        from flax import traverse_util, serialization
+        import jax
+        flat = traverse_util.flatten_dict(
+            serialization.to_state_dict(jax.device_get(engine2._opt_state)),
+            keep_empty_nodes=False)
+        counts = [int(v) for k, v in flat.items() if k[-1] == "count"]
+        assert counts and all(c == 5 for c in counts)
+
     def test_strict_missing_param(self, tmp_path, eight_devices):
         engine, it = _engine()
         engine.train_batch(it)
