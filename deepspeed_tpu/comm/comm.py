@@ -145,8 +145,14 @@ def init_distributed(
     global _initialized
     if _initialized:
         return
-    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
-    num_processes = num_processes or _env_int("NUM_PROCESSES")
+    # DS_TPU_* is the deepspeed_tpu launcher's protocol (launcher/runner.py)
+    coordinator_address = (coordinator_address
+                           or os.environ.get("DS_TPU_COORDINATOR")
+                           or os.environ.get("COORDINATOR_ADDRESS"))
+    num_processes = (num_processes or _env_int("DS_TPU_NUM_PROCS")
+                     or _env_int("NUM_PROCESSES"))
+    if process_id is None:
+        process_id = _env_int("DS_TPU_PROC_ID")
     process_id = process_id if process_id is not None else _env_int("PROCESS_ID")
     if auto_mpi_discovery and process_id is None:
         ompi_rank = _env_int("OMPI_COMM_WORLD_RANK")

@@ -10,7 +10,6 @@ pytree with physically smaller arrays, fixing up consumers listed in
 ``related_modules``.
 """
 
-import fnmatch
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -18,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.compression import functional as F
+from deepspeed_tpu.utils.patterns import match_name as _match
 from deepspeed_tpu.utils.tree import flatten_dots, unflatten_dots
 from deepspeed_tpu.compression.config import (
     CompressionGroup,
@@ -26,12 +26,6 @@ from deepspeed_tpu.compression.config import (
 )
 from deepspeed_tpu.compression.scheduler import CompressionScheduler
 from deepspeed_tpu.utils.logging import logger
-
-
-def _match(path: str, patterns: List[str]) -> bool:
-    return any(
-        fnmatch.fnmatch(path, pat) or fnmatch.fnmatch(path, f"*{pat}*")
-        for pat in patterns)
 
 
 class Compressor:

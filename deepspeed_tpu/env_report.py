@@ -1,0 +1,73 @@
+"""Environment report (reference ``deepspeed/env_report.py:140`` / bin/ds_report).
+
+Prints versions, device inventory, and feature availability — the
+compat-probe table the reference prints for op builders maps to "which
+Pallas/native features are usable here".
+"""
+
+import importlib
+import platform
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try_version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except ImportError:
+        return ""
+
+
+def feature_table():
+    import jax
+
+    rows = []
+    backend = jax.default_backend()
+    rows.append(("jax backend", backend, GREEN_OK))
+    try:
+        devs = jax.devices()
+        rows.append(("devices", f"{len(devs)} x {devs[0].device_kind}",
+                     GREEN_OK))
+    except RuntimeError as e:
+        rows.append(("devices", str(e), RED_NO))
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        rows.append(("pallas kernels",
+                     "native" if backend == "tpu" else "interpret mode",
+                     GREEN_OK))
+    except ImportError:
+        rows.append(("pallas kernels", "unavailable", RED_NO))
+    from deepspeed_tpu.ops import native
+
+    rows.append(("native host ops (C++)",
+                 "built" if native.available() else "not built "
+                 "(python -m deepspeed_tpu.ops.native to build)",
+                 GREEN_OK if native.available() else RED_NO))
+    return rows
+
+
+def main():
+    import jax
+    import deepspeed_tpu
+
+    print("-" * 64)
+    print("deepspeed_tpu environment report")
+    print("-" * 64)
+    print(f"python ............... {sys.version.split()[0]} "
+          f"({platform.platform()})")
+    print(f"deepspeed_tpu ........ {deepspeed_tpu.__version__}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        v = _try_version(mod)
+        print(f"{mod} {'.' * (21 - len(mod))} {v or 'NOT INSTALLED'}")
+    print("-" * 64)
+    for name, value, status in feature_table():
+        print(f"{name:<24} {value:<28} {status}")
+    print("-" * 64)
+
+
+if __name__ == "__main__":
+    main()

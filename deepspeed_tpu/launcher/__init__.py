@@ -1,0 +1,9 @@
+from deepspeed_tpu.launcher.runner import (  # noqa: F401
+    build_host_command,
+    build_ssh_command,
+    decode_world_info,
+    encode_world_info,
+    fetch_hostfile,
+    main,
+    parse_resource_filter,
+)

@@ -1,0 +1,225 @@
+"""Multi-host launcher (reference ``launcher/runner.py:353`` + ``bin/deepspeed``).
+
+The reference spawns one process per GPU per node over PDSH/MPI/Slurm and
+rendezvouses through torch.distributed. The TPU topology is different —
+ONE process per host, all local chips owned by that process, rendezvous via
+``jax.distributed.initialize(coordinator, num_processes, process_id)`` —
+so the runner's job is: parse a hostfile (same MPI-ish ``host slots=N``
+format), apply --include/--exclude filters, pick a coordinator, and launch
+the user script on every host over ssh (or locally for single-host) with
+the JAX cluster env set.
+
+Env protocol (consumed by deepspeed_tpu.comm.init_distributed):
+  DS_TPU_COORDINATOR  host:port of process 0
+  DS_TPU_NUM_PROCS    number of host processes
+  DS_TPU_PROC_ID      this host's index
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_MASTER_PORT = 29500
+
+
+def fetch_hostfile(hostfile_path: str) -> "OrderedDict[str, int]":
+    """Parse MPI-style ``hostname slots=N`` lines (reference runner.py:177).
+    Returns an ordered {hostname: slot_count} map."""
+    if not os.path.isfile(hostfile_path):
+        raise FileNotFoundError(f"hostfile {hostfile_path} not found")
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            try:
+                hostname, slots = line.split()
+                _, count = slots.split("=")
+                count = int(count)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad hostfile line {lineno}: {raw!r} "
+                    f"(want 'host slots=N')") from e
+            if hostname in resources:
+                raise ValueError(f"duplicate host {hostname} in hostfile")
+            resources[hostname] = count
+    if not resources:
+        raise ValueError(f"hostfile {hostfile_path} is empty")
+    return resources
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """'worker-0@worker-1:0,2' -> {worker-0: None, worker-1: [0, 2]}"""
+    out: Dict[str, Optional[List[int]]] = {}
+    if not spec:
+        return out
+    for part in spec.split("@"):
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = sorted(int(s) for s in slots.split(","))
+        else:
+            out[part] = None
+    return out
+
+
+def parse_resource_filter(host_info: "OrderedDict[str, int]",
+                          include_str: str = "",
+                          exclude_str: str = "") \
+        -> "OrderedDict[str, List[int]]":
+    """Apply --include/--exclude (reference runner.py:218). Mutually
+    exclusive. Returns {host: [slot ids]}."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    expanded = OrderedDict(
+        (h, list(range(n))) for h, n in host_info.items())
+    if include_str:
+        inc = _parse_filter(include_str)
+        filtered = OrderedDict()
+        for host, slots in inc.items():
+            if host not in expanded:
+                raise ValueError(f"included host {host} not in hostfile")
+            use = slots if slots is not None else expanded[host]
+            bad = set(use) - set(expanded[host])
+            if bad:
+                raise ValueError(f"host {host} has no slots {sorted(bad)}")
+            filtered[host] = use
+        return filtered
+    if exclude_str:
+        exc = _parse_filter(exclude_str)
+        filtered = OrderedDict()
+        for host, slots in expanded.items():
+            if host in exc:
+                if exc[host] is None:
+                    continue
+                keep = [s for s in slots if s not in exc[host]]
+                if keep:
+                    filtered[host] = keep
+            else:
+                filtered[host] = slots
+        if not filtered:
+            raise ValueError("exclusion filter removed every host")
+        return filtered
+    return expanded
+
+
+def encode_world_info(active: "OrderedDict[str, List[int]]") -> str:
+    """base64 world map, passed to per-host launchers (reference
+    runner.py world_info scheme)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(active).encode()).decode()
+
+
+def decode_world_info(blob: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(blob.encode()).decode())
+
+
+def build_host_command(args, host_idx: int, num_hosts: int,
+                       coordinator: str, world_info: str) -> List[str]:
+    """Command line run on one host."""
+    env_prefix = [
+        "env",
+        f"DS_TPU_COORDINATOR={coordinator}",
+        f"DS_TPU_NUM_PROCS={num_hosts}",
+        f"DS_TPU_PROC_ID={host_idx}",
+        f"DS_TPU_WORLD_INFO={world_info}",
+    ]
+    cmd = env_prefix + [sys.executable, "-u", args.user_script]
+    cmd += args.user_args
+    return cmd
+
+
+def build_ssh_command(host: str, inner_cmd: List[str],
+                      ssh_port: Optional[int] = None,
+                      cwd: Optional[str] = None) -> List[str]:
+    """Remote command runs from the launch cwd with the launch PYTHONPATH,
+    so repo-relative script/data paths resolve the same on every host
+    (reference runner prefixes 'cd {os.path.abspath('.')}')."""
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    remote = f"cd {shlex.quote(cwd or os.getcwd())} && "
+    pythonpath = os.environ.get("PYTHONPATH", "")
+    if pythonpath:
+        remote += f"export PYTHONPATH={shlex.quote(pythonpath)} && "
+    remote += " ".join(shlex.quote(c) for c in inner_cmd)
+    ssh += [host, remote]
+    return ssh
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="deepspeed_tpu multi-host launcher")
+    p.add_argument("-H", "--hostfile", default="/job/hostfile",
+                   help="MPI-style hostfile: one 'host slots=N' per line")
+    p.add_argument("-i", "--include", default="",
+                   help="e.g. 'worker-0@worker-1:0,2'")
+    p.add_argument("-e", "--exclude", default="",
+                   help="inverse of --include")
+    p.add_argument("--num_nodes", type=int, default=-1)
+    p.add_argument("--master_port", type=int, default=DEFAULT_MASTER_PORT)
+    p.add_argument("--master_addr", default="",
+                   help="coordinator address; default = first active host")
+    p.add_argument("--ssh_port", type=int, default=None)
+    p.add_argument("--force_multi", action="store_true")
+    p.add_argument("--dry_run", action="store_true",
+                   help="print the per-host commands without launching")
+    p.add_argument("user_script")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    multi_host = os.path.isfile(args.hostfile) or args.force_multi
+    if multi_host:
+        resources = fetch_hostfile(args.hostfile)
+        active = parse_resource_filter(resources, args.include,
+                                       args.exclude)
+        if args.num_nodes > 0:
+            active = OrderedDict(list(active.items())[:args.num_nodes])
+    else:
+        active = OrderedDict([("localhost", [0])])
+
+    hosts = list(active.keys())
+    coordinator = (args.master_addr or hosts[0]) + f":{args.master_port}"
+    world_info = encode_world_info(active)
+    logger.info(f"launching on {len(hosts)} host(s); "
+                f"coordinator {coordinator}")
+
+    procs = []
+    for idx, host in enumerate(hosts):
+        inner = build_host_command(args, idx, len(hosts), coordinator,
+                                   world_info)
+        cmd = (inner if host in ("localhost", "127.0.0.1")
+               else build_ssh_command(host, inner, args.ssh_port))
+        if args.dry_run:
+            print(" ".join(shlex.quote(c) for c in cmd))
+            continue
+        procs.append(subprocess.Popen(cmd))
+    if args.dry_run:
+        return 0
+
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

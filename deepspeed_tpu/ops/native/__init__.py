@@ -1,0 +1,11 @@
+"""Native (C++) host ops — loaded lazily; built via
+``python -m deepspeed_tpu.ops.native`` (see builder.py)."""
+
+
+def available() -> bool:
+    try:
+        from deepspeed_tpu.ops.native.builder import load_library
+
+        return load_library(build_if_missing=False) is not None
+    except Exception:
+        return False

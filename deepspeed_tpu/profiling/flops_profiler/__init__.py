@@ -1,0 +1,11 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (  # noqa: F401
+    FlopsProfiler,
+    cost_analysis,
+    flops_to_string,
+    get_model_profile,
+    macs_to_string,
+    measure_latency,
+    number_to_string,
+    params_count,
+    params_to_string,
+)

@@ -251,7 +251,29 @@ class DeepSpeedEngine:
         activation_checkpointing.configure(
             self._config, remat=self._config.tpu.remat)
 
+        # curriculum learning / PLD / MoQ (reference engine.py:1629-1663,
+        # :1636-1645, :1921-1930)
+        self.curriculum_scheduler = None
+        if config.curriculum_learning.enabled:
+            from deepspeed_tpu.runtime.data_pipeline import \
+                CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum_learning)
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.progressive_layer_drop.theta,
+                gamma=config.progressive_layer_drop.gamma)
+        self.quantizer = None
+        if config.quantize_training.get("enabled", False):
+            from deepspeed_tpu.runtime.quantize import Quantizer
+            self.quantizer = Quantizer.from_config(config.quantize_training)
+
         # compiled fns (built on first use)
+        self._flops_profiled = False
+        self._reshard_params_fn = None
         self._fwd_bwd_fn = None
         self._apply_fn = None
         self._eval_fn = None
@@ -461,6 +483,18 @@ class DeepSpeedEngine:
         the forward (JAX has no separate backward graph) and cached until
         ``backward()`` commits them — same cost, same calling convention."""
         batch = dict(batch)
+        if self.curriculum_scheduler is not None:
+            # truncate sequence tensors to the scheduled difficulty
+            # (reference injects curriculum_seqlen and slices in the model;
+            # slicing here keeps one compiled program per difficulty value)
+            seqlen = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            batch = {
+                k: (v[:, :seqlen]
+                    if getattr(v, "ndim", 0) >= 2 and v.shape[1] > seqlen
+                    else v)
+                for k, v in batch.items()
+            }
         if not self._initialized:
             self._init_state(batch)
         if self._fwd_bwd_fn is None:
@@ -473,6 +507,24 @@ class DeepSpeedEngine:
         device_batch = self._put_batch(batch)
         self._rng, sub = jax.random.split(self._rng)
         scale = self._ls_state.scale if self.fp16_enabled else jnp.float32(1.0)
+
+        # one-shot flops profile at the configured step (reference
+        # engine.py:1629-1648 activates the profiler for a single step)
+        fp_cfg = self._config.flops_profiler
+        if (fp_cfg.enabled and not self._flops_profiled
+                and self.global_steps >= fp_cfg.profile_step):
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+            log_dist(
+                "flops profiler: compiling a one-off cost-analysis copy of "
+                "the step program (XLA compile, happens once)", ranks=[0])
+            prof = FlopsProfiler(self._fwd_bwd_fn)
+            prof.profile_fn(self._params, self._acc_grads, device_batch,
+                            sub, scale, measure_time=False,
+                            params=self._params)
+            prof.print_profile()
+            self._flops_profiled = True
+
         # grads accumulate eagerly (the donated buffer is consumed here);
         # backward() is the protocol-parity bookkeeping step
         self._acc_grads, loss = self._fwd_bwd_fn(
@@ -530,6 +582,21 @@ class DeepSpeedEngine:
             )
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.quantizer is not None:
+            self._rng, qrng = jax.random.split(self._rng)
+            quantized = self.quantizer.quantize(
+                self._params,
+                overflow=self.fp16_enabled and bool(overflow),
+                eigenvalue_enabled=self.quantizer.q_eigenvalue,
+                rng=qrng)
+            if self._reshard_params_fn is None:
+                # one cached jit: a fresh lambda per step would retrace the
+                # identity resharding program every optimizer step
+                self._reshard_params_fn = jax.jit(
+                    lambda t: t, out_shardings=self._param_shardings)
+            self._params = self._reshard_params_fn(quantized)
         if self.wall_clock_breakdown:
             self.timers(STEP_MICRO_TIMER).stop()
             self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER])

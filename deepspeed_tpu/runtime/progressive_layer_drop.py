@@ -1,0 +1,34 @@
+"""Progressive Layer Dropping (reference ``runtime/progressive_layer_drop.py:5``,
+the PLD paper's keep-probability schedule).
+
+``theta(t) = (1 - theta) * exp(-gamma * t) + theta`` decays the layer keep
+probability from 1.0 toward ``theta``. The engine injects
+``pld_theta`` into the model forward; a scan-over-layers model applies it
+as a per-layer Bernoulli keep gate with keep probability
+``1 - (i / L) * (1 - theta)`` (deeper layers drop more), using an explicit
+PRNG key — JAX's functional randomness replaces the reference's implicit
+torch RNG.
+"""
+
+import math
+from typing import Any, Dict
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (
+            (1.0 - self.theta) * math.exp(-self.gamma * global_step)
+            + self.theta)
+        return self.current_theta

@@ -1,0 +1,202 @@
+"""MoQ — Mixture-of-Quantization training (reference ``runtime/quantize.py:9``).
+
+Progressively quantizes weights DURING training: each matched parameter
+carries (start_bits, target_bits, period); every quantizer step past the
+period drops one bit and doubles the period (optionally stretched by a
+per-block eigenvalue factor — flatter curvature quantizes faster). At
+>=3 bits this is group-wise high-bit quantization, 2 bits ternary, 1 bit
+binary; ``q_mixed_fp16`` blends the quantized and full-precision weights
+while the ratio anneals.
+
+TPU re-design: parameters are immutable pytree leaves, so the per-param
+bit state lives in a host-side dict keyed by parameter path, and
+``quantize(params, ...)`` returns a new tree (applied by the engine at
+gradient-accumulation boundaries, reference engine.py:1921-1930).
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.functional import quantize_weight
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.patterns import match_name
+from deepspeed_tpu.utils.tree import flatten_dots, unflatten_dots
+
+
+def quantize_ternary(w: jnp.ndarray, num_groups: int = 1) -> jnp.ndarray:
+    """2-bit {-a, 0, +a} quantization (reference quantize_tenary): threshold
+    at 0.7 * mean|w| per group, alpha = mean |w| over the kept entries."""
+    orig = w.shape
+    flat = w.reshape(num_groups, -1)
+    m = jnp.mean(jnp.abs(flat), axis=1, keepdims=True)
+    thres = 0.7 * m
+    mask = jnp.abs(flat) > thres
+    kept = jnp.sum(jnp.where(mask, jnp.abs(flat), 0.0), axis=1,
+                   keepdims=True)
+    cnt = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1)
+    alpha = kept / cnt
+    out = jnp.where(mask, jnp.sign(flat) * alpha, 0.0)
+    return out.reshape(orig).astype(w.dtype)
+
+
+def quantize_binary(w: jnp.ndarray, num_groups: int = 1) -> jnp.ndarray:
+    """1-bit sign * mean|w| per group (reference quantize_binary)."""
+    orig = w.shape
+    flat = w.reshape(num_groups, -1)
+    m = jnp.mean(jnp.abs(flat), axis=1, keepdims=True)
+    out = jnp.sign(flat) * m
+    return out.reshape(orig).astype(w.dtype)
+
+
+class _ParamQState:
+    __slots__ = ("start_bits", "target_bits", "period")
+
+    def __init__(self, start_bits: int, target_bits: int, period: int):
+        self.start_bits = start_bits
+        self.target_bits = target_bits
+        self.period = period
+
+
+class Quantizer:
+    """MoQ driver (reference runtime/quantize.py Quantizer)."""
+
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False,
+                 q_change_ratio: float = 0.01, q_type: str = "symmetric",
+                 q_rounding: str = "nearest", q_verbose: bool = False,
+                 q_eigenvalue: bool = False, layer_num: int = 0):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.q_verbose = q_verbose
+        self.q_eigenvalue = q_eigenvalue
+        self.layer_num = layer_num
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+        self._state: Dict[str, _ParamQState] = {}
+
+    @classmethod
+    def from_config(cls, qt: Dict[str, Any]) -> "Quantizer":
+        """Parse the reference's ``quantize_training`` block shape."""
+        bits = qt.get("quantize_bits", {})
+        sched = qt.get("quantize_schedule", {})
+        algo = qt.get("quantize_algo", {})
+        mixed = qt.get("fp16_mixed_quantize", {})
+        q = cls(
+            q_groups=qt.get("quantize_groups", 1),
+            q_mixed_fp16=mixed.get("enabled", False),
+            q_change_ratio=mixed.get("quantize_change_ratio", 0.01),
+            q_type=algo.get("q_type", "symmetric"),
+            q_rounding=algo.get("rounding", "nearest"),
+            q_verbose=qt.get("quantize_verbose", False),
+            q_eigenvalue=qt.get("eigenvalue", {}).get("enabled", False),
+        )
+        q._defaults = (
+            int(bits.get("start_bits", 16)),
+            int(bits.get("target_bits", 8)),
+            int(sched.get("quantize_period", 100)),
+        )
+        q._patterns = qt.get("modules", ["*"])
+        return q
+
+    # ------------------------------------------------------------------
+    def initialize_bits(self, params, start_bits: int, target_bits: int,
+                        period: int, patterns: Optional[List[str]] = None):
+        """Attach bit schedules to every matched >=2-D parameter (the
+        reference sets start_bits/target_bits attrs on tensors)."""
+        patterns = patterns or ["*"]
+        for name, leaf in flatten_dots(params).items():
+            if getattr(leaf, "ndim", 0) < 2:
+                continue
+            if match_name(name, patterns):
+                self._state[name] = _ParamQState(start_bits, target_bits,
+                                                 period)
+
+    def any_precision_switch(self) -> bool:
+        return any(s.start_bits != s.target_bits
+                   for s in self._state.values())
+
+    def step(self):
+        self.qsteps += 1
+
+    def update_fp16_ratio(self):
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    # ------------------------------------------------------------------
+    def compute_quantization(self, w, name: str, factor: int = 1,
+                             rng: Optional[jax.Array] = None):
+        st = self._state[name]
+        if st.start_bits != st.target_bits and self.qsteps >= st.period:
+            self.quantize_real_ratio = 1.0
+            st.period = (st.period << 1) * factor
+            st.start_bits -= 1
+            if self.q_verbose:
+                logger.info(
+                    f"MoQ: {name} -> {st.start_bits} bits at step "
+                    f"{self.qsteps}, next period {st.period}")
+        assert st.start_bits >= st.target_bits, (
+            "quantization bit fell below target precision")
+
+        bits = st.start_bits
+        if bits >= 3:
+            stochastic = self.q_rounding != "nearest"
+            key = None
+            if stochastic:
+                # per-param, per-step, engine-seeded stream: identical keys
+                # across params would correlate rounding errors and break
+                # the aggregate unbiasedness of stochastic rounding
+                base = rng if rng is not None \
+                    else jax.random.PRNGKey(self.qsteps)
+                key = jax.random.fold_in(
+                    base, hash(name) % (2 ** 31))
+            wq = quantize_weight(w, bits, self.q_type,
+                                 "stochastic" if stochastic else "nearest",
+                                 self.q_groups, key=key)
+        elif bits == 2:
+            wq = quantize_ternary(w, self.q_groups)
+        else:
+            wq = quantize_binary(w, self.q_groups)
+
+        if self.q_mixed_fp16 and bits >= st.target_bits - 1:
+            wq = (self.quantize_real_ratio * w
+                  + (1 - self.quantize_real_ratio) * wq)
+        return wq
+
+    def quantize(self, params, overflow: bool = False,
+                 eigenvalue_enabled: bool = False,
+                 block_eigenvalue: Optional[Dict[str, Tuple[float, int]]]
+                 = None, rng: Optional[jax.Array] = None):
+        """One MoQ step over the param tree; returns the new tree
+        (reference Quantizer.quantize, engine.py:1921-1930 call site)."""
+        if overflow and not eigenvalue_enabled:
+            return params
+        if not self._state:
+            if hasattr(self, "_defaults"):
+                self.initialize_bits(params, *self._defaults,
+                                     patterns=getattr(self, "_patterns",
+                                                      None))
+            if not self._state:
+                return params
+
+        self.step()
+        self.update_fp16_ratio()
+
+        flat = flatten_dots(params)
+        for name in self._state:
+            if name not in flat:
+                continue
+            factor = 1
+            if block_eigenvalue:
+                for prefix, (eig, _lid) in block_eigenvalue.items():
+                    if name.startswith(prefix) and eig is not None:
+                        factor = 1 + math.floor(eig * 4)
+                        break
+            flat[name] = self.compute_quantization(flat[name], name, factor,
+                                                   rng=rng)
+        return unflatten_dots(flat)

@@ -1,0 +1,13 @@
+"""Module/parameter name pattern matching shared by compression and MoQ
+(one matcher so the same ``modules`` config selects the same params)."""
+
+import fnmatch
+from typing import List
+
+
+def match_name(name: str, patterns: List[str]) -> bool:
+    """fnmatch with substring fallback: 'attention' matches
+    'layer0.attention.query.kernel'."""
+    return any(
+        fnmatch.fnmatch(name, pat) or fnmatch.fnmatch(name, f"*{pat}*")
+        for pat in patterns)

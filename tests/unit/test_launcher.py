@@ -1,0 +1,150 @@
+"""Launcher tests (reference tests/unit/launcher coverage: hostfile
+parsing, include/exclude filters, world info, command construction)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher import (
+    build_host_command,
+    build_ssh_command,
+    decode_world_info,
+    encode_world_info,
+    fetch_hostfile,
+    parse_resource_filter,
+)
+from deepspeed_tpu.launcher.runner import main, parse_args
+
+
+def write_hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+class TestHostfile:
+    def test_parse(self, tmp_path):
+        hf = write_hostfile(tmp_path,
+                            "worker-0 slots=4\n"
+                            "# a comment\n"
+                            "worker-1 slots=8\n\n")
+        res = fetch_hostfile(hf)
+        assert list(res.items()) == [("worker-0", 4), ("worker-1", 8)]
+
+    def test_bad_lines(self, tmp_path):
+        with pytest.raises(ValueError):
+            fetch_hostfile(write_hostfile(tmp_path, "worker-0\n"))
+        with pytest.raises(ValueError):
+            fetch_hostfile(write_hostfile(
+                tmp_path, "w slots=2\nw slots=2\n"))
+        with pytest.raises(ValueError):
+            fetch_hostfile(write_hostfile(tmp_path, "# only comments\n"))
+        with pytest.raises(FileNotFoundError):
+            fetch_hostfile(str(tmp_path / "nope"))
+
+
+class TestResourceFilter:
+    HOSTS = {"worker-0": 4, "worker-1": 4}
+
+    def test_no_filter(self):
+        from collections import OrderedDict
+
+        active = parse_resource_filter(OrderedDict(self.HOSTS))
+        assert active == {"worker-0": [0, 1, 2, 3],
+                          "worker-1": [0, 1, 2, 3]}
+
+    def test_include(self):
+        from collections import OrderedDict
+
+        active = parse_resource_filter(OrderedDict(self.HOSTS),
+                                       include_str="worker-0@worker-1:0,2")
+        assert active == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 2]}
+
+    def test_exclude(self):
+        from collections import OrderedDict
+
+        active = parse_resource_filter(OrderedDict(self.HOSTS),
+                                       exclude_str="worker-1")
+        assert active == {"worker-0": [0, 1, 2, 3]}
+        active = parse_resource_filter(OrderedDict(self.HOSTS),
+                                       exclude_str="worker-1:1,3")
+        assert active["worker-1"] == [0, 2]
+
+    def test_errors(self):
+        from collections import OrderedDict
+
+        with pytest.raises(ValueError):
+            parse_resource_filter(OrderedDict(self.HOSTS), "a", "b")
+        with pytest.raises(ValueError):
+            parse_resource_filter(OrderedDict(self.HOSTS),
+                                  include_str="ghost")
+        with pytest.raises(ValueError):
+            parse_resource_filter(OrderedDict(self.HOSTS),
+                                  include_str="worker-0:9")
+        with pytest.raises(ValueError):
+            parse_resource_filter(OrderedDict(self.HOSTS),
+                                  exclude_str="worker-0@worker-1")
+
+
+class TestWorldInfo:
+    def test_roundtrip(self):
+        active = {"worker-0": [0, 1], "worker-1": [0]}
+        assert decode_world_info(encode_world_info(active)) == active
+
+
+class TestCommands:
+    def test_host_command_env(self):
+        args = parse_args(["--master_port", "29501", "train.py",
+                           "--lr", "0.1"])
+        cmd = build_host_command(args, host_idx=2, num_hosts=4,
+                                 coordinator="w0:29501", world_info="abc")
+        joined = " ".join(cmd)
+        assert "DS_TPU_COORDINATOR=w0:29501" in joined
+        assert "DS_TPU_NUM_PROCS=4" in joined
+        assert "DS_TPU_PROC_ID=2" in joined
+        assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+    def test_ssh_command_quotes(self):
+        inner = ["env", "A=b c", "python", "t.py"]
+        cmd = build_ssh_command("worker-0", inner, ssh_port=2222)
+        assert cmd[:3] == ["ssh", "-o", "StrictHostKeyChecking=no"]
+        assert "-p" in cmd and "2222" in cmd
+        assert "'A=b c'" in cmd[-1]
+
+    def test_dry_run_single_host(self, capsys):
+        rc = main(["--hostfile", "/nonexistent", "--dry_run",
+                   "train.py"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "DS_TPU_NUM_PROCS=1" in out and "train.py" in out
+
+    def test_dry_run_multi_host(self, tmp_path, capsys):
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0 slots=4\nworker-1 slots=4\n")
+        rc = main(["--hostfile", str(hf), "--dry_run", "train.py"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2
+        assert "ssh" in out[0] and "DS_TPU_PROC_ID=0" in out[0]
+        assert "DS_TPU_PROC_ID=1" in out[1]
+        assert "worker-0:29500" in out[0]
+
+    def test_launch_local_subprocess(self, tmp_path):
+        # end-to-end: really launch a local script and read its env
+        script = tmp_path / "probe.py"
+        script.write_text(
+            "import os\n"
+            "print(os.environ['DS_TPU_COORDINATOR'],"
+            " os.environ['DS_TPU_PROC_ID'])\n")
+        rc = main(["--hostfile", "/nonexistent",
+                   "--master_addr", "localhost", str(script)])
+        assert rc == 0
+
+
+def test_env_report_runs():
+    from deepspeed_tpu import env_report
+
+    rows = env_report.feature_table()
+    assert any("jax backend" == r[0] for r in rows)

@@ -1,0 +1,294 @@
+"""Flops profiler, curriculum learning, PLD, eigenvalue, MoQ tests.
+
+Mirrors reference tests/unit coverage for these features (test_pld.py,
+test_curriculum, flops profiler tests, MoQ config tests).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+from unit.simple_model import SimpleModel, random_dataset
+
+
+# ---------------------------------------------------------------------------
+# flops profiler
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    cost_analysis,
+    get_model_profile,
+    number_to_string,
+)
+
+
+class TestFlopsProfiler:
+    def test_cost_analysis_matmul(self):
+        n = 128
+        f = lambda x: x @ x  # noqa: E731
+        costs = cost_analysis(f, jnp.ones((n, n)))
+        # one n^3 matmul = 2*n^3 flops
+        assert costs["flops"] == pytest.approx(2 * n ** 3, rel=0.01)
+
+    def test_get_model_profile(self):
+        flops, macs, params = get_model_profile(
+            lambda x: jnp.tanh(x @ jnp.ones((64, 64))),
+            args=(jnp.ones((32, 64)),), print_profile=False)
+        assert flops >= 2 * 32 * 64 * 64
+        assert macs == flops / 2
+
+    def test_profiler_with_latency(self):
+        prof = FlopsProfiler(jax.jit(lambda x: x @ x))
+        out = prof.profile_fn(jnp.ones((64, 64)),
+                              params={"w": jnp.ones((3, 3))})
+        assert out["achieved_tflops"] > 0
+        assert out["params"] == 9
+        text = prof.print_profile()
+        assert "TFLOPS" in text
+
+    def test_number_to_string(self):
+        assert number_to_string(2.5e12) == "2.50 T"
+        assert number_to_string(1.5e6) == "1.50 M"
+        assert number_to_string(12) == "12.00 "
+
+    def test_engine_profile_hook(self, eight_devices):
+        cfg = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "flops_profiler": {"enabled": True, "profile_step": 1},
+            "steps_per_print": 1000,
+        }
+        engine, _, loader, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16), config=cfg,
+            training_data=random_dataset(64))
+        it = iter(RepeatingLoader(loader))
+        for _ in range(3):
+            engine.train_batch(it)
+        assert engine._flops_profiled
+
+
+# ---------------------------------------------------------------------------
+# curriculum learning
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+
+class TestCurriculum:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 64, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(50) == 32  # halfway, quantized to 8
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(10 ** 6) == 64
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 0, "max_difficulty": 100,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "root_degree": 2, "difficulty_step": 1}})
+        # sqrt schedule grows faster early
+        assert s.get_difficulty(25) == 50
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [8, 16, 32],
+                                "max_step": [10, 20]}})
+        assert s.get_difficulty(5) == 8
+        assert s.get_difficulty(15) == 16
+        assert s.get_difficulty(25) == 32
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            CurriculumScheduler({"schedule_type": "fixed_linear",
+                                 "schedule_config": {}})
+        with pytest.raises(ValueError):
+            CurriculumScheduler({"schedule_type": "fixed_discrete",
+                                 "schedule_config": {"difficulty": [1, 2],
+                                                     "max_step": [1, 2]}})
+
+    def test_state_roundtrip(self):
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_linear", "min_difficulty": 2,
+            "max_difficulty": 10,
+            "schedule_config": {"total_curriculum_step": 10}})
+        s.update_difficulty(5)
+        sd = s.state_dict()
+        s2 = CurriculumScheduler({
+            "schedule_type": "fixed_linear", "min_difficulty": 2,
+            "max_difficulty": 10,
+            "schedule_config": {"total_curriculum_step": 10}})
+        s2.load_state_dict(sd)
+        assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+# ---------------------------------------------------------------------------
+# progressive layer drop
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+class TestPLD:
+    def test_theta_schedule(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+        assert pld.get_theta() == 1.0
+        pld.update_state(0)
+        assert pld.get_theta() == pytest.approx(1.0)
+        pld.update_state(1000)
+        expected = 0.5 * math.exp(-1.0) + 0.5
+        assert pld.get_theta() == pytest.approx(expected)
+        pld.update_state(10 ** 7)
+        assert pld.get_theta() == pytest.approx(0.5, abs=1e-4)
+        assert pld.get_state()["progressive_layer_drop"] is True
+
+
+# ---------------------------------------------------------------------------
+# eigenvalue
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+
+class TestEigenvalue:
+    def test_quadratic_exact(self):
+        # loss = 0.5 x^T A x has hessian A; top |eig| of diag(1,2,5) is 5
+        A = jnp.diag(jnp.array([1.0, 2.0, 5.0]))
+        params = {"block": {"x": jnp.ones(3)}}
+
+        def loss(p):
+            x = p["block"]["x"]
+            return 0.5 * x @ A @ x
+
+        e = Eigenvalue(max_iter=200, tol=1e-5)
+        val = e.top_eigenvalue(loss, params, "block",
+                               jax.random.PRNGKey(0))
+        assert val == pytest.approx(5.0, rel=1e-2)
+
+    def test_multi_block(self):
+        params = {"a": {"x": jnp.ones(2)}, "b": {"x": jnp.ones(2)}}
+
+        def loss(p):
+            return (2.0 * jnp.sum(p["a"]["x"] ** 2)
+                    + 0.5 * jnp.sum(p["b"]["x"] ** 2))
+
+        e = Eigenvalue(max_iter=100, tol=1e-4)
+        out = e.compute_eigenvalue(loss, params, ["a", "b"],
+                                   jax.random.PRNGKey(1))
+        assert out["a"][0] == pytest.approx(4.0, rel=1e-2)
+        assert out["b"][0] == pytest.approx(1.0, rel=1e-2)
+
+    def test_missing_block(self):
+        e = Eigenvalue()
+        with pytest.raises(KeyError):
+            e.top_eigenvalue(lambda p: jnp.sum(p["a"]["x"]),
+                             {"a": {"x": jnp.ones(2)}}, "nope",
+                             jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# MoQ
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.quantize import (
+    Quantizer,
+    quantize_binary,
+    quantize_ternary,
+)
+
+
+class TestMoQ:
+    def test_ternary_binary(self):
+        w = jnp.asarray(np.random.RandomState(0).randn(8, 8),
+                        dtype=jnp.float32)
+        t = quantize_ternary(w)
+        assert len(np.unique(np.asarray(t))) <= 3
+        b = quantize_binary(w)
+        assert len(np.unique(np.asarray(b))) == 2
+
+    def test_progressive_bit_reduction(self):
+        q = Quantizer(q_verbose=False)
+        params = {"layer": {"kernel": jnp.asarray(
+            np.random.RandomState(1).randn(8, 8), dtype=jnp.float32)}}
+        q.initialize_bits(params, start_bits=8, target_bits=6, period=2)
+        assert q.any_precision_switch()
+        for _ in range(3):
+            params = q.quantize(params)
+        st = q._state["layer.kernel"]
+        assert st.start_bits == 7  # dropped one bit after period 2
+        # period doubled
+        assert st.period == 4
+        for _ in range(10):
+            params = q.quantize(params)
+        assert q._state["layer.kernel"].start_bits == 6
+        assert not q.any_precision_switch()
+
+    def test_overflow_skips(self):
+        q = Quantizer()
+        params = {"w": {"kernel": jnp.ones((4, 4))}}
+        q.initialize_bits(params, 8, 8, 10)
+        out = q.quantize(params, overflow=True)
+        assert out is params  # untouched
+
+    def test_engine_moq_integration(self, eight_devices):
+        cfg = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "quantize_training": {
+                "enabled": True,
+                "quantize_groups": 1,
+                "quantize_bits": {"start_bits": 8, "target_bits": 8},
+                "quantize_schedule": {"quantize_period": 1},
+            },
+            "steps_per_print": 1000,
+        }
+        engine, _, loader, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16), config=cfg,
+            training_data=random_dataset(64))
+        it = iter(RepeatingLoader(loader))
+        for _ in range(2):
+            engine.train_batch(it)
+        k = np.asarray(jax.device_get(
+            engine._params)["linear_0"]["kernel"])
+        assert len(np.unique(k)) <= 2 ** 8
+
+
+# ---------------------------------------------------------------------------
+# curriculum + engine
+# ---------------------------------------------------------------------------
+class TestCurriculumEngine:
+    def test_engine_truncates_seq(self, eight_devices):
+        from unit.simple_model import tiny_gpt_config, random_token_batches
+        from deepspeed_tpu.models.transformer_lm import GPT
+
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}},
+            "steps_per_print": 1000,
+        }
+        model = GPT(tiny_gpt_config(n_positions=32))
+        data = random_token_batches(16, 2, 32, 128)
+        # flatten into per-sample dicts for the dataloader
+        samples = [{"input_ids": b["input_ids"][i],
+                    "labels": b["labels"][i]}
+                   for b in data for i in range(2)]
+        engine, _, loader, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, training_data=samples)
+        it = iter(RepeatingLoader(loader))
+        losses = [float(engine.train_batch(it)) for _ in range(5)]
+        assert all(np.isfinite(losses))
+        assert engine.curriculum_scheduler.get_current_difficulty() == 32
