@@ -1,0 +1,4 @@
+from deepspeed_tpu.ops.adam.cpu_adam import (  # noqa: F401
+    DeepSpeedCPUAdagrad,
+    DeepSpeedCPUAdam,
+)

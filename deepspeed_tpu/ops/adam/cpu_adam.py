@@ -1,0 +1,152 @@
+"""Host-offloaded CPU Adam/Adagrad (reference ``ops/adam/cpu_adam.py:12``
+DeepSpeedCPUAdam / ``ops/adagrad/cpu_adagrad.py:10``).
+
+Runs the optimizer math on host cores over numpy views of the optimizer
+shard while the device keeps only bf16/fp32 params — the ZeRO-Offload
+pattern. The C++ kernel (ops/native/csrc/cpu_adam.cpp) is multithreaded and
+auto-vectorized; a pure-numpy fallback keeps the API working where the
+native library cannot build.
+"""
+
+import ctypes
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _f32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _as_f32_flat(a: np.ndarray) -> np.ndarray:
+    out = np.ascontiguousarray(a, dtype=np.float32).reshape(-1)
+    return out
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam over flat numpy shards.
+
+    ``step(params_list, grads_list)`` updates params in place (each entry a
+    float32 numpy array; views into pinned buffers work too).
+    """
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True, fp32_optimizer_states: bool = True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self._m: Dict[int, np.ndarray] = {}
+        self._v: Dict[int, np.ndarray] = {}
+        self._lib = None
+        try:
+            from deepspeed_tpu.ops.native.builder import load_library
+
+            self._lib = load_library()
+        except Exception as e:  # pragma: no cover - build env dependent
+            logger.warning(f"native cpu_adam unavailable ({e}); "
+                           f"using numpy fallback")
+
+    def _state_for(self, i: int, n: int):
+        if i not in self._m:
+            self._m[i] = np.zeros(n, dtype=np.float32)
+            self._v[i] = np.zeros(n, dtype=np.float32)
+        if self._m[i].size != n:
+            raise ValueError(
+                f"param {i} changed size ({self._m[i].size} -> {n}); the "
+                f"param list must be stable across steps")
+        return self._m[i], self._v[i]
+
+    def step(self, params: List[np.ndarray],
+             grads: List[np.ndarray]) -> int:
+        """One fused Adam step over every (param, grad) pair."""
+        self.step_count += 1
+        beta1, beta2 = self.betas
+        for i, (p, g) in enumerate(zip(params, grads)):
+            if p.dtype != np.float32 or not p.flags.c_contiguous:
+                raise TypeError(
+                    f"param {i} must be contiguous float32 (got {p.dtype}); "
+                    f"keep master weights fp32 on host")
+            flat_p = p.reshape(-1)
+            flat_g = _as_f32_flat(g)
+            m, v = self._state_for(i, flat_p.size)
+            if self._lib is not None:
+                self._lib.ds_adam_update(
+                    _f32ptr(flat_p), _f32ptr(flat_g), _f32ptr(m), _f32ptr(v),
+                    flat_p.size, self.step_count, self.lr, beta1, beta2,
+                    self.eps, self.weight_decay,
+                    1 if self.adamw_mode else 0)
+            else:
+                self._numpy_adam(flat_p, flat_g, m, v)
+        return self.step_count
+
+    def _numpy_adam(self, p, g, m, v):
+        beta1, beta2 = self.betas
+        t = self.step_count
+        if not self.adamw_mode and self.weight_decay > 0:
+            g = g + self.weight_decay * p
+        m *= beta1
+        m += (1 - beta1) * g
+        v *= beta2
+        v += (1 - beta2) * g * g
+        bias1 = 1 - beta1 ** t
+        bias2 = 1 - beta2 ** t
+        denom = np.sqrt(v / bias2) + self.eps
+        if self.adamw_mode and self.weight_decay > 0:
+            p *= 1 - self.lr * self.weight_decay
+        p -= self.lr / bias1 * (m / denom)
+
+    # reference also exposes per-group state_dict-ish access
+    def state(self, i: int):
+        return {"exp_avg": self._m.get(i), "exp_avg_sq": self._v.get(i)}
+
+
+class DeepSpeedCPUAdagrad:
+    """Fused host Adagrad (reference DeepSpeedCPUAdagrad)."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self._sq: Dict[int, np.ndarray] = {}
+        self._lib = None
+        try:
+            from deepspeed_tpu.ops.native.builder import load_library
+
+            self._lib = load_library()
+        except Exception:  # pragma: no cover
+            pass
+
+    def step(self, params: List[np.ndarray],
+             grads: List[np.ndarray]) -> int:
+        self.step_count += 1
+        for i, (p, g) in enumerate(zip(params, grads)):
+            if p.dtype != np.float32 or not p.flags.c_contiguous:
+                raise TypeError(
+                    f"param {i} must be contiguous float32 (got {p.dtype})")
+            flat_p = p.reshape(-1)
+            flat_g = _as_f32_flat(g)
+            if i not in self._sq:
+                self._sq[i] = np.zeros(flat_p.size, dtype=np.float32)
+            elif self._sq[i].size != flat_p.size:
+                raise ValueError(
+                    f"param {i} changed size; param list must be stable")
+            sq = self._sq[i]
+            if self._lib is not None:
+                self._lib.ds_adagrad_update(
+                    _f32ptr(flat_p), _f32ptr(flat_g), _f32ptr(sq),
+                    flat_p.size, self.step_count, self.lr, self.eps,
+                    self.weight_decay)
+            else:
+                if self.weight_decay > 0:
+                    flat_g = flat_g + self.weight_decay * flat_p
+                sq += flat_g * flat_g
+                flat_p -= self.lr * flat_g / (np.sqrt(sq) + self.eps)
+        return self.step_count

@@ -1,0 +1,91 @@
+"""Async host file I/O (reference ``csrc/aio`` + ``ops/aio``): the swap
+backend for ZeRO-Infinity-style SSD tiers. ``AioHandle`` mirrors the
+reference aio_handle verbs (async_pread/async_pwrite/wait + sync forms)
+over the native threadpool, with a synchronous numpy fallback."""
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class AioHandle:
+    def __init__(self, num_threads: int = 4):
+        self.num_threads = num_threads
+        self._lib = None
+        self._h = None
+        try:
+            from deepspeed_tpu.ops.native.builder import load_library
+
+            self._lib = load_library()
+            self._h = self._lib.ds_aio_handle_create(num_threads)
+        except Exception as e:  # pragma: no cover - build env dependent
+            logger.warning(f"native aio unavailable ({e}); synchronous "
+                           f"fallback")
+
+    def close(self):
+        if self._lib is not None and self._h:
+            self._lib.ds_aio_handle_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    def async_pwrite(self, array: np.ndarray, path: str,
+                     offset: int = 0) -> None:
+        """Queue a write of ``array``'s bytes to ``path`` at ``offset``."""
+        buf = np.ascontiguousarray(array)
+        if self._h:
+            # keep a ref until wait() so the buffer can't be collected
+            self._pending = getattr(self, "_pending", [])
+            self._pending.append(buf)
+            self._lib.ds_aio_pwrite(
+                self._h, path.encode(), ctypes.c_void_p(buf.ctypes.data),
+                buf.nbytes, offset)
+        else:
+            with open(path, "r+b" if os.path.exists(path) else "wb") as f:
+                f.seek(offset)
+                f.write(buf.tobytes())
+
+    def async_pread(self, array: np.ndarray, path: str,
+                    offset: int = 0) -> None:
+        """Queue a read of ``array.nbytes`` from ``path`` into ``array``."""
+        if not array.flags.c_contiguous:
+            raise ValueError("read target must be contiguous")
+        if self._h:
+            self._pending = getattr(self, "_pending", [])
+            self._pending.append(array)
+            self._lib.ds_aio_pread(
+                self._h, path.encode(), ctypes.c_void_p(array.ctypes.data),
+                array.nbytes, offset)
+        else:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(array.nbytes)
+            array[...] = np.frombuffer(
+                data, dtype=array.dtype).reshape(array.shape)
+
+    def wait(self) -> int:
+        """Block until all queued ops finish; raises on I/O error."""
+        if self._h:
+            err = self._lib.ds_aio_wait(self._h)
+            self._pending = []
+            if err:
+                raise IOError(f"aio error code {err}")
+        return 0
+
+    # sync conveniences (reference sync_pread/sync_pwrite)
+    def sync_pwrite(self, array: np.ndarray, path: str, offset: int = 0):
+        self.async_pwrite(array, path, offset)
+        self.wait()
+
+    def sync_pread(self, array: np.ndarray, path: str, offset: int = 0):
+        self.async_pread(array, path, offset)
+        self.wait()

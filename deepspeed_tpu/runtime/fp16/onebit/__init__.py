@@ -1,0 +1,5 @@
+from deepspeed_tpu.runtime.fp16.onebit.adam import (  # noqa: F401
+    OnebitAdamState,
+    compressed_allreduce,
+    onebit_adam,
+)
