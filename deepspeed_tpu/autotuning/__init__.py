@@ -1,0 +1,13 @@
+"""Autotuning (reference ``deepspeed/autotuning/``): measured in-process
+sweeps over ZeRO-stage x micro-batch experiments with grid/random/
+model-based tuners."""
+
+from deepspeed_tpu.autotuning.autotuner import (  # noqa: F401
+    Autotuner,
+    AutotuningConfig,
+)
+from deepspeed_tpu.autotuning.tuner import (  # noqa: F401
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+)

@@ -1,0 +1,162 @@
+"""Autotuner (reference ``autotuning/autotuner.py:31`` + scheduler.py).
+
+Reference flow: profile the model once, generate ZeRO-stage x micro-batch
+experiment configs from templates, launch each as a separate job via the
+resource manager, read back metrics, pick the best config. TPU re-design:
+experiments run IN-PROCESS — each candidate builds a fresh engine, runs a
+few measured steps on the real compiled program, and reports throughput.
+That keeps the semantics (real measured steps, not a model) while dropping
+the multi-job machinery a single TPU host doesn't need; multi-host sweeps
+can still fan the same experiment list out via the launcher.
+"""
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_TUNING_MICRO_BATCHES = (1, 2, 4, 8)
+DEFAULT_ZERO_STAGES = (0, 1, 2, 3)
+
+
+class AutotuningConfig:
+    """Parse the reference's ``autotuning`` block (constants.py keys)."""
+
+    def __init__(self, d: Optional[Dict[str, Any]] = None):
+        d = d or {}
+        self.enabled = d.get("enabled", False)
+        self.fast = d.get("fast", True)
+        self.metric = d.get("metric", "throughput")
+        self.start_profile_step = d.get("start_profile_step", 3)
+        self.end_profile_step = d.get("end_profile_step", 5)
+        self.tuner_type = d.get("tuner_type", "gridsearch")
+        self.tuner_num_trials = d.get("tuner_num_trials", 50)
+        self.tuner_early_stopping = d.get("tuner_early_stopping", 5)
+        self.max_train_micro_batch_size_per_gpu = d.get(
+            "max_train_micro_batch_size_per_gpu", 64)
+        self.min_train_micro_batch_size_per_gpu = d.get(
+            "min_train_micro_batch_size_per_gpu", 1)
+        self.num_tuning_micro_batch_sizes = d.get(
+            "num_tuning_micro_batch_sizes", 3)
+        self.zero_stages = d.get("zero_stages", list(DEFAULT_ZERO_STAGES))
+        self.mp_size = d.get("mp_size", 1)
+        if self.metric not in ("throughput", "latency", "flops"):
+            raise ValueError(f"unknown autotuning metric {self.metric!r}")
+        if self.tuner_type not in ("gridsearch", "random", "model_based"):
+            raise ValueError(
+                f"unknown tuner_type {self.tuner_type!r}; expected "
+                f"gridsearch|random|model_based")
+
+
+class Autotuner:
+    """Generate and evaluate (zero_stage, micro_batch) experiments."""
+
+    def __init__(self, base_config: Dict[str, Any],
+                 tuning_config: Optional[Dict[str, Any]] = None):
+        self.base_config = dict(base_config)
+        self.base_config.pop("autotuning", None)
+        self.cfg = AutotuningConfig(
+            tuning_config
+            if tuning_config is not None
+            else base_config.get("autotuning", {}))
+
+    # ------------------------------------------------------------------
+    def generate_experiments(self) -> List[Dict[str, Any]]:
+        """ZeRO-stage x micro-batch grid (reference _generate_experiments
+        from config_templates/template_zero*.json). Micro batches are
+        powers of two SPANNING [min, max], subsampled evenly to
+        num_tuning_micro_batch_sizes (largest always kept — it is usually
+        the throughput winner)."""
+        lo = self.cfg.min_train_micro_batch_size_per_gpu
+        hi = self.cfg.max_train_micro_batch_size_per_gpu
+        candidates = []
+        m = 1
+        while m <= hi:
+            if m >= lo:
+                candidates.append(m)
+            m *= 2
+        if not candidates:
+            candidates = [lo]
+        n = min(self.cfg.num_tuning_micro_batch_sizes, len(candidates))
+        idx = [round(i * (len(candidates) - 1) / max(n - 1, 1))
+               for i in range(n)]
+        mbs = sorted({candidates[i] for i in idx})
+        exps = []
+        for stage, mb in itertools.product(self.cfg.zero_stages, mbs):
+            exps.append({"zero_stage": stage,
+                         "train_micro_batch_size_per_gpu": mb})
+        return exps
+
+    def exp_to_config(self, exp: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = dict(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = \
+            exp["train_micro_batch_size_per_gpu"]
+        cfg.pop("train_batch_size", None)  # re-derived from micro batch
+        zero = dict(cfg.get("zero_optimization", {}))
+        zero["stage"] = exp["zero_stage"]
+        cfg["zero_optimization"] = zero
+        return cfg
+
+    # ------------------------------------------------------------------
+    def measure(self, model_factory: Callable[[], Any],
+                data: List[Any], exp: Dict[str, Any]) -> Optional[float]:
+        """Run one experiment in-process; returns the metric (higher is
+        better) or None if the config fails (e.g. OOM)."""
+        import deepspeed_tpu
+        from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+        config = self.exp_to_config(exp)
+        try:
+            import jax
+
+            engine, _, loader, _ = deepspeed_tpu.initialize(
+                model=model_factory(), config=config, training_data=data)
+            it = iter(RepeatingLoader(loader))
+            for _ in range(self.cfg.start_profile_step):
+                engine.train_batch(it)  # warmup + compile
+            steps = max(self.cfg.end_profile_step
+                        - self.cfg.start_profile_step, 1)
+            # fence async dispatch so compile/warmup tails don't leak into
+            # the timed region (same pattern as flops_profiler latency)
+            jax.block_until_ready(engine._params)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                engine.train_batch(it)
+            jax.block_until_ready(engine._params)
+            dt = (time.perf_counter() - t0) / steps
+        except Exception as e:
+            logger.warning(f"experiment {exp} failed: {e}")
+            return None
+        samples = engine.train_batch_size
+        if self.cfg.metric == "latency":
+            return -dt
+        # throughput (and flops ~ proportional at fixed model)
+        return samples / dt
+
+    # ------------------------------------------------------------------
+    def tune(self, model_factory: Callable[[], Any],
+             data: List[Any]) -> Dict[str, Any]:
+        """Full loop: returns the best full engine config."""
+        from deepspeed_tpu.autotuning.tuner import (
+            GridSearchTuner,
+            ModelBasedTuner,
+            RandomTuner,
+        )
+
+        exps = self.generate_experiments()
+        tuner_cls = {"gridsearch": GridSearchTuner,
+                     "random": RandomTuner,
+                     "model_based": ModelBasedTuner}[self.cfg.tuner_type]
+        tuner = tuner_cls(
+            exps, lambda e: self.measure(model_factory, data, e),
+            early_stopping=self.cfg.tuner_early_stopping)
+        best = tuner.tune(self.cfg.tuner_num_trials)
+        if best is None:
+            raise RuntimeError("autotuning found no working experiment")
+        logger.info(
+            f"autotuning best: {best} "
+            f"({self.cfg.metric}={tuner.best_metric:.2f}); "
+            f"{len(tuner.records)} experiments evaluated")
+        self.records = tuner.records
+        return self.exp_to_config(best)

@@ -1,0 +1,115 @@
+"""Experiment-selection strategies (reference ``autotuning/tuner/``:
+index_based_tuner.py grid/random, model_based_tuner.py + cost_model.py).
+
+The model-based tuner replaces the reference's xgboost cost model with an
+incrementally-fit ridge regression over one-hot experiment features —
+no extra dependency, same role: predict the metric for unexplored
+experiments and evaluate the most promising first.
+"""
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+Experiment = Dict[str, Any]
+
+
+class BaseTuner:
+    def __init__(self, exps: List[Experiment],
+                 metric_fn: Callable[[Experiment], Optional[float]],
+                 early_stopping: int = 0):
+        self.all_exps = list(exps)
+        self.metric_fn = metric_fn
+        self.early_stopping = early_stopping
+        self.records: List[Tuple[Experiment, Optional[float]]] = []
+        self.best_exp: Optional[Experiment] = None
+        self.best_metric = -float("inf")
+
+    def next_batch(self, k: int) -> List[Experiment]:
+        raise NotImplementedError
+
+    def tune(self, num_trials: Optional[int] = None) -> Experiment:
+        budget = num_trials or len(self.all_exps)
+        stale = 0
+        while self.all_exps and len(self.records) < budget:
+            for exp in self.next_batch(1):
+                metric = self.metric_fn(exp)
+                self.records.append((exp, metric))
+                if metric is not None and metric > self.best_metric:
+                    self.best_metric = metric
+                    self.best_exp = exp
+                    stale = 0
+                elif self.best_exp is not None:
+                    # failures before ANY success (e.g. leading OOM configs)
+                    # must not exhaust the early-stopping budget
+                    stale += 1
+            if self.early_stopping and stale >= self.early_stopping:
+                break
+        return self.best_exp
+
+
+class GridSearchTuner(BaseTuner):
+    """In-order exhaustive sweep (reference index_based_tuner.py)."""
+
+    def next_batch(self, k: int) -> List[Experiment]:
+        batch, self.all_exps = self.all_exps[:k], self.all_exps[k:]
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, exps, metric_fn, early_stopping: int = 0,
+                 seed: int = 0):
+        super().__init__(exps, metric_fn, early_stopping)
+        self._rng = random.Random(seed)
+
+    def next_batch(self, k: int) -> List[Experiment]:
+        k = min(k, len(self.all_exps))
+        picks = [self.all_exps.pop(self._rng.randrange(len(self.all_exps)))
+                 for _ in range(k)]
+        return picks
+
+
+class ModelBasedTuner(BaseTuner):
+    """Predict-then-evaluate (reference model_based_tuner.py:14)."""
+
+    def __init__(self, exps, metric_fn, early_stopping: int = 0,
+                 explore: int = 2):
+        super().__init__(exps, metric_fn, early_stopping)
+        self.explore = explore  # random warm-start evaluations
+        self._keys = sorted({(k, str(v)) for e in exps
+                             for k, v in e.items()})
+        self._index = {kv: i for i, kv in enumerate(self._keys)}
+
+    def _featurize(self, exp: Experiment) -> np.ndarray:
+        x = np.zeros(len(self._keys) + 1, dtype=np.float64)
+        x[-1] = 1.0  # bias
+        for k, v in exp.items():
+            i = self._index.get((k, str(v)))
+            if i is not None:
+                x[i] = 1.0
+        return x
+
+    def _predict(self) -> Optional[np.ndarray]:
+        obs = [(self._featurize(e), m) for e, m in self.records
+               if m is not None]
+        if len(obs) < self.explore:
+            return None
+        X = np.stack([x for x, _ in obs])
+        y = np.array([m for _, m in obs])
+        d = X.shape[1]
+        w = np.linalg.solve(X.T @ X + 1e-3 * np.eye(d), X.T @ y)
+        return np.stack(
+            [self._featurize(e) for e in self.all_exps]) @ w
+
+    def next_batch(self, k: int) -> List[Experiment]:
+        preds = self._predict()
+        out = []
+        for _ in range(min(k, len(self.all_exps))):
+            if preds is None:
+                out.append(self.all_exps.pop(0))
+            else:
+                i = int(np.argmax(preds))
+                preds = np.delete(preds, i)
+                out.append(self.all_exps.pop(i))
+        return out

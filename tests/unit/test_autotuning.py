@@ -1,0 +1,119 @@
+"""Autotuning tests (reference tests/unit/autotuning coverage)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (
+    Autotuner,
+    AutotuningConfig,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+)
+
+from unit.simple_model import SimpleModel, random_dataset
+
+
+class TestTuners:
+    EXPS = [{"mb": m, "stage": s} for m in (1, 2, 4) for s in (0, 1)]
+
+    @staticmethod
+    def metric(exp):
+        # synthetic landscape: best at mb=4, stage=0
+        return exp["mb"] * 10 - exp["stage"] * 5
+
+    @pytest.mark.parametrize("cls", [GridSearchTuner, RandomTuner,
+                                     ModelBasedTuner])
+    def test_finds_best(self, cls):
+        tuner = cls(list(self.EXPS), self.metric)
+        best = tuner.tune()
+        assert best == {"mb": 4, "stage": 0}
+        assert tuner.best_metric == 40
+
+    def test_failed_experiments_skipped(self):
+        def metric(exp):
+            return None if exp["mb"] == 4 else exp["mb"]
+
+        tuner = GridSearchTuner(list(self.EXPS), metric)
+        best = tuner.tune()
+        assert best["mb"] == 2
+
+    def test_early_stopping_bounds_evals(self):
+        calls = []
+
+        def metric(exp):
+            calls.append(exp)
+            return -len(calls)  # strictly worsening
+
+        tuner = GridSearchTuner(list(self.EXPS), metric, early_stopping=2)
+        tuner.tune()
+        assert len(calls) <= 3
+
+    def test_model_based_prefers_predicted_good(self):
+        # warm start sees mb=4 (great) and mb=1 (poor); the ridge model
+        # must then jump to the remaining mb=4 experiment even though grid
+        # order would evaluate mb=1/mb=2 first
+        exps = [{"mb": 4, "stage": 1}, {"mb": 1, "stage": 1},
+                {"mb": 1, "stage": 0}, {"mb": 2, "stage": 0},
+                {"mb": 2, "stage": 1}, {"mb": 4, "stage": 0}]
+        tuner = ModelBasedTuner(list(exps), self.metric, explore=2)
+        tuner.tune()
+        evaluated = [e for e, _ in tuner.records]
+        assert evaluated[:2] == exps[:2]  # warm start in list order
+        assert evaluated[2]["mb"] == 4, evaluated
+
+    def test_failures_before_success_dont_early_stop(self):
+        # leading OOM-like failures must not exhaust the stale budget
+        def metric(exp):
+            return None if exp["stage"] == 0 else exp["mb"]
+
+        exps = sorted(self.EXPS, key=lambda e: e["stage"])  # failures first
+        tuner = GridSearchTuner(list(exps), metric, early_stopping=2)
+        best = tuner.tune()
+        assert best is not None and best["stage"] == 1
+
+
+class TestAutotuningConfig:
+    def test_defaults_and_validation(self):
+        cfg = AutotuningConfig({})
+        assert cfg.tuner_type == "gridsearch"
+        with pytest.raises(ValueError):
+            AutotuningConfig({"metric": "vibes"})
+        with pytest.raises(ValueError):
+            AutotuningConfig({"tuner_type": "grid"})
+
+    def test_micro_batch_span(self):
+        at = Autotuner({}, {"min_train_micro_batch_size_per_gpu": 1,
+                            "max_train_micro_batch_size_per_gpu": 64,
+                            "num_tuning_micro_batch_sizes": 3,
+                            "zero_stages": [0]})
+        mbs = sorted(e["train_micro_batch_size_per_gpu"]
+                     for e in at.generate_experiments())
+        assert mbs[0] == 1 and mbs[-1] == 64  # spans the range
+        assert len(mbs) == 3
+
+
+class TestAutotunerEndToEnd:
+    def test_experiment_generation(self):
+        at = Autotuner({"optimizer": {"type": "AdamW",
+                                      "params": {"lr": 1e-3}}},
+                       {"zero_stages": [0, 1],
+                        "num_tuning_micro_batch_sizes": 2})
+        exps = at.generate_experiments()
+        assert len(exps) == 4
+        cfg = at.exp_to_config(exps[-1])
+        assert cfg["zero_optimization"]["stage"] == 1
+        assert "train_batch_size" not in cfg
+
+    def test_tune_real_engine(self, eight_devices):
+        at = Autotuner(
+            {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+             "steps_per_print": 1000},
+            {"zero_stages": [0, 1], "num_tuning_micro_batch_sizes": 2,
+             "start_profile_step": 1, "end_profile_step": 2})
+        best_cfg = at.tune(lambda: SimpleModel(hidden_dim=16),
+                           random_dataset(256))
+        assert best_cfg["train_micro_batch_size_per_gpu"] in (1, 2)
+        assert best_cfg["zero_optimization"]["stage"] in (0, 1)
+        # every generated experiment was evaluated (grid search)
+        assert len(at.records) == 4
