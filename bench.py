@@ -33,13 +33,15 @@ def main():
     )
 
     seq = 1024
-    micro = 8
+    micro = 16
     cfg = gpt2_config(
         "gpt2-125m",
         n_positions=seq,
         dtype=jnp.bfloat16,
         scan_layers=True,
         remat=True,
+        remat_policy="selective",   # save MXU outputs, recompute VPU work
+        use_flash_attention=True,   # Pallas blockwise attention
     )
     model = GPT(cfg)
     ds_config = {
@@ -68,16 +70,24 @@ def main():
         engine.backward()
         engine.step()
 
+    def fence():
+        # scalar-only host read: on tunneled backends block_until_ready can
+        # return before the compute queue drains, and converting a full
+        # array pulls megabytes over the wire — a device-side reduction
+        # read back as one float is the only honest fence
+        return float(jnp.sum(jax.tree.leaves(engine.params)[0]
+                             .astype(jnp.float32)))
+
     # compile + warmup
     one_step()
     one_step()
-    jax.block_until_ready(jax.tree.leaves(engine.params)[0])
+    fence()
 
     steps = 10
     t0 = time.time()
     for _ in range(steps):
         one_step()
-    jax.block_until_ready(jax.tree.leaves(engine.params)[0])
+    fence()
     dt = (time.time() - t0) / steps
 
     tokens_per_step = gb * seq

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Collective bandwidth sweeps (reference benchmarks/communication/*):
+all_reduce / all_gather / reduce_scatter / all_to_all / ppermute over the
+mesh, reporting algbw and busbw per payload size.
+
+Run on real hardware (single chip: loopback numbers) or the virtual CPU
+mesh:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python benchmarks/communication/run_all.py --backend cpu
+"""
+
+import argparse
+
+import time
+
+
+def busbw_factor(op: str, n: int) -> float:
+    """Bus-bandwidth correction (ring-algorithm accounting, reference
+    benchmarks/communication/utils.py): allreduce moves 2(n-1)/n bytes per
+    byte of payload, gather/scatter (n-1)/n."""
+    if n <= 1:
+        return 1.0
+    if op == "all_reduce":
+        return 2 * (n - 1) / n
+    if op in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--backend", default=None, choices=[None, "cpu"],
+                   help="cpu = force the virtual host-device mesh")
+    p.add_argument("--ops", default="all_reduce,all_gather,"
+                   "reduce_scatter,all_to_all,ppermute")
+    p.add_argument("--min-bytes", type=int, default=1 << 16)
+    p.add_argument("--max-bytes", type=int, default=1 << 26)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    n = len(devs)
+    mesh = Mesh(devs, ("x",))
+    print(f"# {n} x {devs[0].device_kind}")
+
+    def make(op):
+        def body(x):
+            x = x[0]
+            if op == "all_reduce":
+                r = jax.lax.psum(x, "x")
+            elif op == "all_gather":
+                r = jax.lax.all_gather(x, "x", axis=0, tiled=True)
+            elif op == "reduce_scatter":
+                r = jax.lax.psum_scatter(x, "x", scatter_dimension=0,
+                                         tiled=True)
+            elif op == "all_to_all":
+                r = jax.lax.all_to_all(x.reshape(n, -1), "x", 0, 0,
+                                       tiled=False).reshape(-1)
+            elif op == "ppermute":
+                r = jax.lax.ppermute(
+                    x, "x", [(i, (i + 1) % n) for i in range(n)])
+            return jnp.sum(r, keepdims=True)[None]
+
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P("x", None), out_specs=P("x", None),
+            check_vma=False))
+
+    print(f"{'op':<15}{'bytes':>12}{'time_ms':>10}{'algbw_GBps':>12}"
+          f"{'busbw_GBps':>12}")
+    for op in args.ops.split(","):
+        fn = make(op)
+        size = args.min_bytes
+        while size <= args.max_bytes:
+            elems = size // 4
+            elems = max(elems - elems % (n * n), n * n)
+            x = jnp.ones((n, elems), jnp.float32)
+            r = fn(x)
+            float(jnp.sum(r))  # compile + fence
+            t0 = time.time()
+            for _ in range(args.iters):
+                r = fn(x)
+            float(jnp.sum(r))
+            dt = (time.time() - t0) / args.iters
+            payload = elems * 4
+            algbw = payload / dt / 1e9
+            busbw = algbw * busbw_factor(op, n)
+            print(f"{op:<15}{payload:>12}{dt * 1e3:>10.2f}{algbw:>12.2f}"
+                  f"{busbw:>12.2f}")
+            size *= 4
+    print("# done")
+
+
+if __name__ == "__main__":
+    main()

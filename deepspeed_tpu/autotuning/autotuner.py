@@ -79,8 +79,11 @@ class Autotuner:
         if not candidates:
             candidates = [lo]
         n = min(self.cfg.num_tuning_micro_batch_sizes, len(candidates))
-        idx = [round(i * (len(candidates) - 1) / max(n - 1, 1))
-               for i in range(n)]
+        # even spread ANCHORED AT THE LARGEST candidate (usually the
+        # throughput winner): n=1 must pick max, not min
+        last = len(candidates) - 1
+        idx = [last - round(i * last / max(n - 1, 1))
+               for i in range(n)] if n > 1 else [last]
         mbs = sorted({candidates[i] for i in idx})
         exps = []
         for stage, mb in itertools.product(self.cfg.zero_stages, mbs):

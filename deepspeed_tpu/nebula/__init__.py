@@ -1,0 +1,21 @@
+"""Nebula checkpoint-service glue (reference ``deepspeed/nebula/`` is
+config/constants only — the service itself is Azure-managed). Parsed for
+config compatibility; enabling it routes checkpoints through the async
+tiered pattern of runtime/checkpoint_engine."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class NebulaConfig:
+    enabled: bool = False
+    persistent_storage_path: str = ""
+    persistent_time_interval: int = 100
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+
+    @classmethod
+    def from_dict(cls, d):
+        d = d or {}
+        return cls(**{k: v for k, v in d.items()
+                      if k in cls.__dataclass_fields__})

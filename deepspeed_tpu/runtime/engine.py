@@ -225,6 +225,7 @@ class DeepSpeedEngine:
         )
         self._initialized = False
         self._rng = jax.random.PRNGKey(seed)
+        self._unit_scale = jnp.float32(1.0)
 
         # host counters
         self.micro_steps = 0
@@ -365,7 +366,11 @@ class DeepSpeedEngine:
         model = self.module
         gas = self.gradient_accumulation_steps
 
-        def fwd_bwd(params, acc_grads, batch, rng, scale):
+        def fwd_bwd(params, acc_grads, batch, rng, step, scale):
+            # fold the step counter in HERE: a host-side jax.random.split per
+            # micro step costs a full small-op dispatch round-trip
+            rng = jax.random.fold_in(rng, step)
+
             def loss_fn(p):
                 loss = model.apply(
                     {"params": p}, **batch, deterministic=False,
@@ -505,8 +510,7 @@ class DeepSpeedEngine:
         self.tput_timer.start()
 
         device_batch = self._put_batch(batch)
-        self._rng, sub = jax.random.split(self._rng)
-        scale = self._ls_state.scale if self.fp16_enabled else jnp.float32(1.0)
+        scale = self._ls_state.scale if self.fp16_enabled else self._unit_scale
 
         # one-shot flops profile at the configured step (reference
         # engine.py:1629-1648 activates the profiler for a single step)
@@ -520,15 +524,16 @@ class DeepSpeedEngine:
                 "the step program (XLA compile, happens once)", ranks=[0])
             prof = FlopsProfiler(self._fwd_bwd_fn)
             prof.profile_fn(self._params, self._acc_grads, device_batch,
-                            sub, scale, measure_time=False,
-                            params=self._params)
+                            self._rng, self.micro_steps, scale,
+                            measure_time=False, params=self._params)
             prof.print_profile()
             self._flops_profiled = True
 
         # grads accumulate eagerly (the donated buffer is consumed here);
         # backward() is the protocol-parity bookkeeping step
         self._acc_grads, loss = self._fwd_bwd_fn(
-            self._params, self._acc_grads, device_batch, sub, scale
+            self._params, self._acc_grads, device_batch, self._rng,
+            self.micro_steps, scale
         )
         self._backward_pending = True
         self._last_loss = loss

@@ -3,3 +3,8 @@ from deepspeed_tpu.runtime.fp16.onebit.adam import (  # noqa: F401
     compressed_allreduce,
     onebit_adam,
 )
+from deepspeed_tpu.runtime.fp16.onebit.lamb import (  # noqa: F401
+    ZeroOneAdamState,
+    onebit_lamb,
+    zero_one_adam,
+)

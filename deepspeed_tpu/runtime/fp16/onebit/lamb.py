@@ -1,0 +1,137 @@
+"""1-bit LAMB and 0/1 Adam — the other compressed-communication optimizers
+(reference ``runtime/fp16/onebit/lamb.py:11`` OnebitLamb,
+``zoadam.py:10`` ZeroOneAdam).
+
+Both reuse :func:`~deepspeed_tpu.runtime.fp16.onebit.adam.compressed_allreduce`
+(int8 signs + fp32 scales over the dp axis with two-phase error feedback):
+
+* ``onebit_lamb`` — 1-bit Adam's warmup/compression phases plus LAMB's
+  layerwise trust ratio ||w|| / ||update|| applied at the step, so large
+  layers keep stable effective LRs under compression noise.
+* ``zero_one_adam`` — 0/1 Adam's looser sync schedule: the variance is
+  refreshed every ``var_update_period`` steps (not frozen forever) and
+  momentum sync can be skipped ``local_steps`` at a time between
+  compressed exchanges.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import (
+    OnebitAdamState,
+    compressed_allreduce,
+    onebit_adam,
+)
+
+
+def onebit_lamb(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                warmup_steps: int = 100, axis: str = "dp",
+                axis_size: Optional[int] = None,
+                min_trust: float = 0.01, max_trust: float = 10.0):
+    """1-bit Adam core + LAMB layerwise trust-ratio scaling."""
+    inner = onebit_adam(1.0, b1, b2, eps, 0.0, warmup_steps, axis,
+                        axis_size)
+
+    def init(params):
+        return inner.init(params)
+
+    def update(grads, state, params):
+        raw_updates, state = inner.update(grads, state, params)
+
+        def scale_one(p, u):
+            upd = -u  # inner returns the negative step at lr=1
+            if weight_decay > 0:
+                upd = upd + weight_decay * p
+            wn = jnp.linalg.norm(p.astype(jnp.float32))
+            un = jnp.linalg.norm(upd.astype(jnp.float32))
+            trust = jnp.where(
+                (wn > 0) & (un > 0),
+                jnp.clip(wn / jnp.maximum(un, 1e-12), min_trust, max_trust),
+                1.0)
+            return (-learning_rate * trust * upd).astype(p.dtype)
+
+        return jax.tree.map(scale_one, params, raw_updates), state
+
+    return optax.GradientTransformation(init, update)
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    worker_error: optax.Updates
+    server_error: optax.Updates
+
+
+def zero_one_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+                  eps: float = 1e-8, weight_decay: float = 0.0,
+                  var_update_period: int = 16, axis: str = "dp",
+                  axis_size: Optional[int] = None):
+    """0/1 Adam: compressed momentum sync every step, exact variance
+    refresh every ``var_update_period`` steps (reference zoadam.py's
+    adaptive variance/momentum update policies, simplified to fixed
+    periods)."""
+    if axis_size is None:
+        raise ValueError("pass axis_size (dp world size)")
+
+    base = onebit_adam(learning_rate, b1, b2, eps, weight_decay,
+                       warmup_steps=1, axis=axis, axis_size=axis_size)
+
+    def init(params):
+        s = base.init(params)
+        return ZeroOneAdamState(*s)
+
+    def update(grads, state, params):
+        count = state.count + 1
+        # refresh at step 1 too: an all-zero variance until the first
+        # period boundary would make 1/(sqrt(v)+eps) explode
+        refresh = ((count % var_update_period) == 0) | (count == 1)
+
+        # compressed momentum exchange (always)
+        local_m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                               state.exp_avg, grads)
+        flat_m, treedef = jax.tree.flatten(local_m)
+        flat_we = jax.tree.leaves(state.worker_error)
+        flat_se = jax.tree.leaves(state.server_error)
+        out_m, out_we, out_se = [], [], []
+        for m, we, se in zip(flat_m, flat_we, flat_se):
+            red, we2, se2 = compressed_allreduce(m.reshape(-1), we, se,
+                                                 axis)
+            out_m.append(red.reshape(m.shape))
+            out_we.append(we2)
+            out_se.append(se2)
+        exp_avg = jax.tree.unflatten(treedef, out_m)
+
+        # periodic exact variance refresh with pmean'd grads
+        def refreshed(operand):
+            grads, v = operand
+            g_avg = jax.tree.map(lambda g: jax.lax.pmean(g, axis), grads)
+            return jax.tree.map(lambda vv, g: b2 * vv + (1 - b2) * g * g,
+                                v, g_avg)
+
+        exp_avg_sq = jax.lax.cond(
+            refresh, refreshed, lambda o: o[1], (grads, state.exp_avg_sq))
+
+        bias1 = 1 - b1 ** count.astype(jnp.float32)
+        # v sees one update per refresh (steps 1, P, 2P, ...); count them
+        n_refresh = (1 + count // var_update_period).astype(jnp.float32)
+        bias2 = 1 - b2 ** n_refresh
+
+        def step_one(p, m, v):
+            denom = jnp.sqrt(v / bias2) + eps
+            upd = m / bias1 / denom
+            if weight_decay > 0:
+                upd = upd + weight_decay * p
+            return (-learning_rate * upd).astype(p.dtype)
+
+        updates = jax.tree.map(step_one, params, exp_avg, exp_avg_sq)
+        return updates, ZeroOneAdamState(
+            count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+            worker_error=jax.tree.unflatten(treedef, out_we),
+            server_error=jax.tree.unflatten(treedef, out_se))
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,56 @@
+"""Sparse gradient representation + allreduce
+(reference ``runtime/sparse_tensor.py:11`` SparseTensor and the
+allgather-based sparse allreduce in ``engine.py:2300-2382``).
+
+Embedding gradients touch only the rows of the tokens in the batch; the
+reference ships (indices, values) pairs and allgathers them instead of
+reducing the dense [vocab, dim] tensor. Same here, as a pytree-friendly
+NamedTuple plus shard_map-ready collectives: ``sparse_allreduce`` allgathers
+rows over the axis and scatter-adds locally. Static shapes: the index count
+is fixed per batch shape, so XLA compiles one program.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor(NamedTuple):
+    indices: jnp.ndarray      # [nnz] int32 row ids
+    values: jnp.ndarray       # [nnz, ...] row payloads
+    dense_shape: Tuple[int, ...]
+
+    @property
+    def sparse_size(self) -> int:
+        return int(self.indices.shape[0]) * int(
+            jnp.prod(jnp.array(self.values.shape[1:])))
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+
+def from_dense_rows(dense: jnp.ndarray, indices: jnp.ndarray) -> SparseTensor:
+    """Build a SparseTensor from the given rows of a dense tensor (the
+    engine knows which rows a batch touched — its token ids)."""
+    return SparseTensor(indices=indices.astype(jnp.int32),
+                        values=dense[indices],
+                        dense_shape=tuple(dense.shape))
+
+
+def sparse_allreduce(st: SparseTensor, axis: str) -> SparseTensor:
+    """Mean-allreduce of a sparse gradient over mesh axis ``axis``
+    (reference sparse_allreduce_no_retain: allgather indices+values, keep
+    sparse). Call inside shard_map. Result nnz = world * nnz."""
+    k = jax.lax.psum(1, axis)
+    all_idx = jax.lax.all_gather(st.indices, axis, axis=0, tiled=True)
+    all_val = jax.lax.all_gather(st.values, axis, axis=0, tiled=True)
+    return SparseTensor(indices=all_idx, values=all_val / k,
+                        dense_shape=st.dense_shape)
+
+
+def apply_sparse_grad(param: jnp.ndarray, st: SparseTensor,
+                      lr: float) -> jnp.ndarray:
+    """SGD-style scatter-add application without densifying."""
+    return param.at[st.indices].add(-lr * st.values.astype(param.dtype))

@@ -1,0 +1,77 @@
+"""Tiled linear layers (reference ``runtime/zero/tiling.py:27`` TiledLinear).
+
+The reference splits one huge Linear into a grid of smaller Linears so
+ZeRO-3 can fetch/release weight tiles one at a time. On TPU the analogous
+memory pressure is XLA temp buffers for giant [in, out] matmuls; tiling by
+input splits turns one matmul into an accumulation of smaller ones that
+the scheduler can stream. Output splits shard the bias/activation side.
+"""
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TiledLinear(nn.Module):
+    """Drop-in Dense replacement computing y = sum_i x_i @ W_ij per output
+    tile j. Weight tiles are separate parameters (``tile_i_j``), so
+    sharding rules and ZeRO-3 partitioning see small, independently
+    fetchable arrays (the reference's core trick)."""
+
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        if in_features % self.in_splits:
+            raise ValueError(
+                f"in_features {in_features} not divisible by in_splits "
+                f"{self.in_splits}")
+        if self.features % self.out_splits:
+            raise ValueError(
+                f"features {self.features} not divisible by out_splits "
+                f"{self.out_splits}")
+        in_tile = in_features // self.in_splits
+        out_tile = self.features // self.out_splits
+        dtype = self.dtype or x.dtype
+
+        x_tiles = jnp.split(x, self.in_splits, axis=-1)
+        # init variance must use the FULL fan-in (sum over in_splits tiles
+        # behaves like one Dense): scale lecun by 1/in_splits
+        tile_init = nn.initializers.variance_scaling(
+            1.0 / self.in_splits, "fan_in", "truncated_normal")
+        out_tiles = []
+        for j in range(self.out_splits):
+            acc = None
+            for i in range(self.in_splits):
+                w = self.param(
+                    f"tile_{i}_{j}", tile_init,
+                    (in_tile, out_tile), self.param_dtype)
+                part = x_tiles[i].astype(dtype) @ w.astype(dtype)
+                acc = part if acc is None else acc + part
+            if self.use_bias:
+                b = self.param(f"bias_{j}", nn.initializers.zeros,
+                               (out_tile,), self.param_dtype)
+                acc = acc + b.astype(dtype)
+            out_tiles.append(acc)
+        return jnp.concatenate(out_tiles, axis=-1)
+
+    @staticmethod
+    def from_dense_kernel(kernel, in_splits: int, out_splits: int):
+        """Split a dense [in, out] kernel into the tile param dict
+        (reference copy_params_from)."""
+        import numpy as np
+
+        kernel = np.asarray(kernel)
+        rows = np.split(kernel, in_splits, axis=0)
+        out = {}
+        for i, row in enumerate(rows):
+            for j, tile in enumerate(np.split(row, out_splits, axis=1)):
+                out[f"tile_{i}_{j}"] = tile
+        return out

@@ -1,0 +1,83 @@
+"""Meta-device model construction (reference ``utils/init_on_device.py:10``
+OnDevice: build huge models without allocating real weights).
+
+JAX already separates trace from allocation, so "meta init" is
+``jax.eval_shape`` over ``model.init`` — exact shapes/dtypes, zero bytes.
+``OnDevice(dtype=..., device="meta")`` keeps the reference's context-manager
+spelling; ``materialize`` turns the abstract tree into real (optionally
+sharded) arrays, which is where a ZeRO-3 build hands each leaf its
+partition spec instead of ever holding the full model.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """Context manager + helpers for abstract-then-materialize init."""
+
+    _active: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=jnp.float32, device: str = "meta",
+                 enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        if self.enabled:
+            OnDevice._active = self
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = None
+        return False
+
+    # ------------------------------------------------------------------
+    def init(self, model, rngs, *args, **kwargs):
+        """model.init that never allocates: returns a ShapeDtypeStruct
+        pytree when device == 'meta', real arrays otherwise."""
+        if self.enabled and self.device == "meta":
+            out = jax.eval_shape(lambda r: model.init(r, *args, **kwargs),
+                                 rngs)
+            return jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape,
+                    self.dtype if jnp.issubdtype(s.dtype, jnp.floating)
+                    else s.dtype),
+                out)
+        return model.init(rngs, *args, **kwargs)
+
+    @staticmethod
+    def materialize(abstract_tree, init_fn=None, rng=None,
+                    shardings=None):
+        """Turn a meta tree into real arrays. ``init_fn(key, shape, dtype)``
+        defaults to zeros; with ``shardings`` each leaf is created directly
+        with its target sharding (the zero.Init pattern: nothing is ever
+        allocated unsharded)."""
+        leaves, treedef = jax.tree.flatten(abstract_tree)
+        if init_fn is None:
+            def init_fn(key, shape, dtype):
+                return jnp.zeros(shape, dtype)
+        keys = (jax.random.split(rng, len(leaves)) if rng is not None
+                else [None] * len(leaves))
+
+        shard_leaves = (jax.tree.leaves(shardings)
+                        if shardings is not None else [None] * len(leaves))
+        out = []
+        for leaf, key, shard in zip(leaves, keys, shard_leaves):
+            make = lambda: init_fn(key, leaf.shape, leaf.dtype)  # noqa: E731
+            if shard is not None:
+                arr = jax.jit(make, out_shardings=shard)()
+            else:
+                arr = make()
+            out.append(arr)
+        return jax.tree.unflatten(treedef, out)
+
+
+def param_count(abstract_tree) -> int:
+    import numpy as np
+
+    return int(sum(np.prod(l.shape) for l in jax.tree.leaves(abstract_tree)))

@@ -135,6 +135,10 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        self._fence_epoch_time = None  # wall clock at last fenced report
+        self._fence_epoch_step = 0
+        self._fenced_total_time = 0.0
+        self._fenced_total_steps = 0
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
@@ -151,7 +155,10 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            _sync()
+            # NO device fence here: syncing every micro step would serialize
+            # the dispatch pipeline (one fence costs a full in-flight step).
+            # Throughput is fenced only at reporting boundaries, so the
+            # running average is exact and intermediate steps overlap.
             self.start_time = time.time()
 
     def stop(self, global_step: bool = False, report_speed: bool = True):
@@ -162,7 +169,6 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _sync()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
@@ -171,6 +177,23 @@ class ThroughputTimer:
             if global_step and report_speed and (
                 self.global_step_count % self.steps_per_output == 0
             ):
+                # steps in between are dispatch-only (no fence); honest
+                # throughput = samples between fenced boundaries / the
+                # fenced wall time between them
+                _sync()
+                now = time.time()
+                if self._fence_epoch_time is not None:
+                    span = now - self._fence_epoch_time
+                    steps = self.global_step_count - self._fence_epoch_step
+                    curr = (self.batch_size * steps / span) if span > 0 \
+                        else 0.0
+                else:
+                    curr = 0.0
+                if self._fence_epoch_time is not None:
+                    self._fenced_total_time += span
+                    self._fenced_total_steps += steps
+                self._fence_epoch_time = now
+                self._fence_epoch_step = self.global_step_count
                 self.logging(
                     "epoch={}/micro_step={}/global_step={}, "
                     "RunningAvgSamplesPerSec={:.3f}, CurrSamplesPerSec={:.3f}".format(
@@ -178,15 +201,18 @@ class ThroughputTimer:
                         self.micro_step_count,
                         self.global_step_count,
                         self.avg_samples_per_sec(),
-                        self.batch_size / self.step_elapsed_time
-                        if self.step_elapsed_time
-                        else 0.0,
+                        curr,
                     )
                 )
         if global_step:
             self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self):
+        # fenced boundary-to-boundary accounting when available (exact);
+        # falls back to accumulated host durations before the first report
+        if self._fenced_total_time > 0:
+            return (self.batch_size * self._fenced_total_steps
+                    / self._fenced_total_time)
         if self.global_step_count > self.start_step:
             samples = self.batch_size * (self.global_step_count - self.start_step)
             if self.total_elapsed_time > 0:

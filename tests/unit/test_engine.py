@@ -207,3 +207,21 @@ def test_eval_batch(eight_devices):
     batch = next(it)
     out = engine.eval_batch({"x": batch["x"]})
     assert out.shape == (4 * engine.topology.data_parallel_size, 1)
+
+
+@pytest.mark.parametrize("policy,scan", [("full", True),
+                                         ("selective", True),
+                                         ("full", False)])
+def test_gpt_remat_trains(eight_devices, policy, scan):
+    """Regression: nn.remat must keep decode/deterministic static (they
+    arrive via closure), in both the scanned and unrolled layer paths."""
+    from deepspeed_tpu.models.transformer_lm import GPT
+
+    cfg = tiny_gpt_config(remat=True, remat_policy=policy,
+                          scan_layers=scan)
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=GPT(cfg), config=base_config(train_micro_batch_size_per_gpu=2),
+        training_data=None)
+    batches = random_token_batches(4, 16, 32, 128)  # 2 per chip x dp 8
+    losses = [float(engine.train_batch(iter([b]))) for b in batches]
+    assert all(np.isfinite(losses))

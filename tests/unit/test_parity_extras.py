@@ -1,0 +1,232 @@
+"""SparseTensor, TiledLinear, OnDevice, state-dict factory, onebit
+variants — small-parity-component tests."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+# ---------------------------------------------------------------------------
+# sparse tensor
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.sparse_tensor import (
+    SparseTensor,
+    apply_sparse_grad,
+    from_dense_rows,
+    sparse_allreduce,
+)
+
+
+class TestSparseTensor:
+    def test_roundtrip_and_scatter_add(self):
+        dense = jnp.zeros((10, 4)).at[jnp.array([1, 3, 1])].add(1.0)
+        st = from_dense_rows(dense, jnp.array([1, 3]))
+        np.testing.assert_array_equal(np.asarray(st.to_dense()),
+                                      np.asarray(dense))
+        p = jnp.ones((10, 4))
+        p2 = apply_sparse_grad(p, st, lr=0.5)
+        assert float(p2[1, 0]) == 1 - 0.5 * 2  # duplicate index summed
+        assert float(p2[0, 0]) == 1.0
+
+    def test_sparse_allreduce(self, eight_devices):
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P("dp", None), P("dp", None)),
+            out_specs=P("dp", None), check_vma=False)
+        def reduce(idx, val):
+            st = SparseTensor(idx[0], val[0], (16, 2))
+            out = sparse_allreduce(st, "dp")
+            return out.to_dense()[None]
+
+        # every worker contributes row r = its rank with value 1
+        idx = np.arange(8, dtype=np.int32).reshape(8, 1)
+        val = np.ones((8, 1, 2), np.float32)
+        dense = np.asarray(reduce(idx, val))[0]
+        # mean over 8 workers: each touched row has 1/8
+        np.testing.assert_allclose(dense[:8], np.full((8, 2), 1 / 8))
+        assert dense[8:].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# tiled linear
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+
+class TestTiledLinear:
+    def test_matches_dense(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+        tl = TiledLinear(features=8, in_splits=4, out_splits=2)
+        params = tl.init(jax.random.PRNGKey(1), x)["params"]
+        y = tl.apply({"params": params}, x)
+        assert y.shape == (4, 8)
+        # compose the equivalent dense kernel and compare
+        k = np.zeros((16, 8), np.float32)
+        for i in range(4):
+            for j in range(2):
+                k[i * 4:(i + 1) * 4, j * 4:(j + 1) * 4] = \
+                    np.asarray(params[f"tile_{i}_{j}"])
+        b = np.concatenate([np.asarray(params[f"bias_{j}"])
+                            for j in range(2)])
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(x) @ k + b, rtol=1e-5)
+
+    def test_from_dense_kernel(self):
+        k = np.arange(32, dtype=np.float32).reshape(8, 4)
+        tiles = TiledLinear.from_dense_kernel(k, in_splits=2, out_splits=2)
+        np.testing.assert_array_equal(tiles["tile_0_0"], k[:4, :2])
+        np.testing.assert_array_equal(tiles["tile_1_1"], k[4:, 2:])
+
+    def test_divisibility(self):
+        x = jnp.ones((2, 10))
+        with pytest.raises(ValueError):
+            TiledLinear(features=8, in_splits=3).init(
+                jax.random.PRNGKey(0), x)
+
+
+# ---------------------------------------------------------------------------
+# OnDevice meta init
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.utils.init_on_device import OnDevice, param_count
+
+
+class TestOnDevice:
+    def test_meta_init_no_alloc_then_materialize(self):
+        import flax.linen as nn
+
+        model = nn.Dense(64)
+        x = jnp.ones((1, 32))
+        with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+            abstract = ctx.init(model, jax.random.PRNGKey(0), x)
+        kernel = abstract["params"]["kernel"]
+        assert isinstance(kernel, jax.ShapeDtypeStruct)
+        assert kernel.shape == (32, 64) and kernel.dtype == jnp.bfloat16
+        assert param_count(abstract) == 32 * 64 + 64
+
+        real = OnDevice.materialize(abstract)
+        assert float(jnp.sum(jnp.abs(real["params"]["kernel"]))) == 0.0
+
+        rng_real = OnDevice.materialize(
+            abstract,
+            init_fn=lambda k, s, d: jax.random.normal(k, s, jnp.float32
+                                                      ).astype(d),
+            rng=jax.random.PRNGKey(1))
+        assert float(jnp.sum(jnp.abs(
+            rng_real["params"]["kernel"].astype(jnp.float32)))) > 0
+
+
+# ---------------------------------------------------------------------------
+# state dict factory
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.state_dict_factory import (
+    SDLoaderFactory,
+    strategy_for,
+)
+
+
+class TestSDLoader:
+    def _write_shards(self, tmp_path, degree=2):
+        rng = np.random.RandomState(0)
+        qkv = rng.randn(8, 12).astype(np.float32)  # fused qkv [in, 3*h]
+        fc1 = rng.randn(8, 16).astype(np.float32)
+        fc2 = rng.randn(16, 8).astype(np.float32)
+        ln = rng.randn(8).astype(np.float32)
+        from deepspeed_tpu.checkpoint.reshape_utils import split_tp_param
+
+        files = []
+        qs = split_tp_param(qkv, degree, "qkv", axis=1)
+        c1 = split_tp_param(fc1, degree, "column", axis=1)
+        c2 = split_tp_param(fc2, degree, "row", axis=0)
+        for r in range(degree):
+            path = tmp_path / f"mp_rank_{r:02d}.npz"
+            np.savez(path, **{
+                "h.c_attn.kernel": qs[r],
+                "h.c_fc.kernel": c1[r],
+                "h.c_proj.kernel": c2[r],
+                "h.ln.scale": ln,
+            })
+            files.append(str(path))
+        return files, dict(qkv=qkv, fc1=fc1, fc2=fc2, ln=ln)
+
+    def test_strategy_routing(self):
+        assert strategy_for("h.c_attn.kernel")[0] == "qkv"
+        assert strategy_for("h.c_fc.kernel")[0] == "column"
+        assert strategy_for("h.c_proj.kernel")[0] == "row"
+        assert strategy_for("h.ln.scale")[0] == "replicate"
+
+    def test_merge_and_resplit(self, tmp_path):
+        files, ref = self._write_shards(tmp_path)
+        loader = SDLoaderFactory.get_sd_loader(str(tmp_path))
+        merged = loader.merge_state_dict()
+        np.testing.assert_allclose(merged["h.c_attn.kernel"], ref["qkv"])
+        np.testing.assert_allclose(merged["h.c_fc.kernel"], ref["fc1"])
+        np.testing.assert_allclose(merged["h.c_proj.kernel"], ref["fc2"])
+        np.testing.assert_allclose(merged["h.ln.scale"], ref["ln"])
+        # resplit at degree 4
+        r0 = loader.get_split_state_dict(4, 0)
+        assert r0["h.c_fc.kernel"].shape == (8, 4)
+        assert r0["h.c_proj.kernel"].shape == (4, 8)
+        assert r0["h.ln.scale"].shape == (8,)
+        # tree conversion
+        tree = loader.as_tree(merged)
+        assert tree["h"]["c_attn"]["kernel"].shape == (8, 12)
+
+    def test_missing_files(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            SDLoaderFactory.get_sd_loader(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# onebit lamb / 0-1 adam
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.fp16.onebit import onebit_lamb, zero_one_adam
+
+
+class TestOnebitVariants:
+    def _fit(self, tx, steps=100):
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        rng = np.random.RandomState(3)
+        X = rng.randn(64, 16).astype(np.float32)
+        y = X @ rng.randn(16).astype(np.float32)
+        # non-zero init: LAMB's trust ratio ||w||/||update|| legitimately
+        # suppresses steps from an all-zero weight
+        params = {"w": jnp.asarray(0.1 * rng.randn(16), jnp.float32)}
+        state = tx.init(params)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(), jax.tree.map(lambda _: P(), state),
+                      P("dp", None), P("dp")),
+            out_specs=(P(), jax.tree.map(lambda _: P(), state)),
+            check_vma=False)
+        def step(params, state, xb, yb):
+            g = jax.grad(lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(params)
+            u, state = tx.update(g, state, params)
+            return jax.tree.map(lambda p, du: p + du, params, u), state
+
+        l0 = float(np.mean((X @ np.asarray(params["w"]) - y) ** 2))
+        for _ in range(steps):
+            params, state = step(params, state, X, y)
+        l1 = float(np.mean((X @ np.asarray(params["w"]) - y) ** 2))
+        return l0, l1
+
+    def test_onebit_lamb_converges(self, eight_devices):
+        l0, l1 = self._fit(onebit_lamb(1e-1, warmup_steps=10, axis="dp",
+                                       axis_size=8), steps=150)
+        assert l1 < 0.2 * l0, (l0, l1)
+
+    def test_zero_one_adam_converges(self, eight_devices):
+        l0, l1 = self._fit(zero_one_adam(5e-2, var_update_period=8,
+                                         axis="dp", axis_size=8))
+        assert l1 < 0.2 * l0, (l0, l1)
+
+    def test_zoadam_requires_axis_size(self):
+        with pytest.raises(ValueError):
+            zero_one_adam(1e-2)
