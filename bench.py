@@ -65,10 +65,12 @@ def main():
     }
     batch["labels"] = batch["input_ids"]
 
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    it = iter(RepeatingLoader([batch]))
+
     def one_step():
-        engine.forward(batch)
-        engine.backward()
-        engine.step()
+        engine.train_batch(it)  # fused single-program step when gas == 1
 
     def fence():
         # scalar-only host read: on tunneled backends block_until_ready can

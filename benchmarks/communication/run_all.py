@@ -51,7 +51,14 @@ def main():
     mesh = Mesh(devs, ("x",))
     print(f"# {n} x {devs[0].device_kind}")
 
+    KNOWN_OPS = ("all_reduce", "all_gather", "reduce_scatter",
+                 "all_to_all", "ppermute")
+
     def make(op):
+        if op not in KNOWN_OPS:
+            raise SystemExit(
+                f"unknown op {op!r}; choose from {', '.join(KNOWN_OPS)}")
+
         def body(x):
             x = x[0]
             if op == "all_reduce":
@@ -75,7 +82,7 @@ def main():
 
     print(f"{'op':<15}{'bytes':>12}{'time_ms':>10}{'algbw_GBps':>12}"
           f"{'busbw_GBps':>12}")
-    for op in args.ops.split(","):
+    for op in (o.strip() for o in args.ops.split(",") if o.strip()):
         fn = make(op)
         size = args.min_bytes
         while size <= args.max_bytes:

@@ -275,6 +275,7 @@ class DeepSpeedEngine:
         # compiled fns (built on first use)
         self._flops_profiled = False
         self._reshard_params_fn = None
+        self._train_step_fn = None
         self._fwd_bwd_fn = None
         self._apply_fn = None
         self._eval_fn = None
@@ -430,6 +431,61 @@ class DeepSpeedEngine:
             out_shardings=(
                 self._param_shardings, self._opt_shardings, self._grad_shardings,
                 None, None, None,
+            ),
+        )
+
+    def _build_train_step(self):
+        """Fused fwd+bwd+optimizer in ONE compiled program (used by
+        train_batch when gas == 1): one dispatch instead of two, and XLA
+        overlaps the optimizer update with the tail of the backward."""
+        model = self.module
+        tx = self._tx
+        clip = self.gradient_clipping
+        check_fp16 = self.fp16_enabled
+        ls_config = self._ls_config
+
+        def train_step(params, opt_state, ls_state, batch, rng, step):
+            rng = jax.random.fold_in(rng, step)
+
+            def loss_fn(p):
+                loss = model.apply(
+                    {"params": p}, **batch, deterministic=False,
+                    rngs={"dropout": rng,
+                          "gating": jax.random.fold_in(rng, 7)},
+                )
+                return loss * ls_state.scale, loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32) / ls_state.scale, grads)
+            overflow = has_overflow(grads) if check_fp16 \
+                else jnp.bool_(False)
+            grad_norm = optax.global_norm(grads)
+            if clip and clip > 0:
+                factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+
+            def do_update(operand):
+                params, opt_state, grads = operand
+                updates, new_opt = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), new_opt
+
+            def skip_update(operand):
+                params, opt_state, _ = operand
+                return params, opt_state
+
+            new_params, new_opt = jax.lax.cond(
+                overflow, skip_update, do_update,
+                (params, opt_state, grads))
+            new_ls = update_loss_scale(ls_state, overflow, ls_config)
+            return new_params, new_opt, new_ls, loss, overflow, grad_norm
+
+        return jax.jit(
+            train_step,
+            donate_argnums=(0, 1),
+            out_shardings=(
+                self._param_shardings, self._opt_shardings,
+                None, None, None, None,
             ),
         )
 
@@ -618,7 +674,12 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter):
         """Full effective-batch step: gas micro steps + model update
         (PipelineEngine.train_batch parity, pipe/engine.py:296). Returns the
-        mean micro loss."""
+        mean micro loss. With gas == 1 the whole step runs as one fused
+        compiled program (fwd+bwd+optimizer)."""
+        if (self.gradient_accumulation_steps == 1
+                and not self._config.flops_profiler.enabled
+                and not self.wall_clock_breakdown):
+            return self._train_batch_fused(next(data_iter))
         losses = []
         for _ in range(self.gradient_accumulation_steps):
             batch = next(data_iter)
@@ -627,6 +688,66 @@ class DeepSpeedEngine:
             losses.append(loss)
             self.step()
         return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
+
+    def _train_batch_fused(self, batch):
+        batch = dict(batch)
+        if self.curriculum_scheduler is not None:
+            seqlen = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            batch = {
+                k: (v[:, :seqlen]
+                    if getattr(v, "ndim", 0) >= 2 and v.shape[1] > seqlen
+                    else v)
+                for k, v in batch.items()
+            }
+        if not self._initialized:
+            self._init_state(batch)
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+
+        self.tput_timer.start()
+        device_batch = self._put_batch(batch)
+        (self._params, self._opt_state, self._ls_state, loss, overflow,
+         _grad_norm) = self._train_step_fn(
+            self._params, self._opt_state, self._ls_state, device_batch,
+            self._rng, self.micro_steps)
+        self._last_loss = loss
+        self.micro_steps += 1
+        self.global_steps += 1
+        self.global_samples += (
+            self.train_micro_batch_size_per_gpu
+            * self.topology.data_parallel_size)
+
+        # same host bookkeeping as _take_model_step; bool(overflow) forces
+        # a sync so it is gated on fp16 exactly like the unfused path
+        if self.fp16_enabled and bool(overflow):
+            self.skipped_steps += 1
+            log_dist(
+                f"overflow at step {self.global_steps}; loss scale -> "
+                f"{float(self._ls_state.scale)}", ranks=[0])
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.quantizer is not None:
+            self._rng, qrng = jax.random.split(self._rng)
+            quantized = self.quantizer.quantize(
+                self._params,
+                overflow=self.fp16_enabled and bool(overflow),
+                eigenvalue_enabled=self.quantizer.q_eigenvalue,
+                rng=qrng)
+            if self._reshard_params_fn is None:
+                self._reshard_params_fn = jax.jit(
+                    lambda t: t, out_shardings=self._param_shardings)
+            self._params = self._reshard_params_fn(quantized)
+        if self.global_steps % self._config.steps_per_print == 0:
+            self._report_progress()
+        if self.monitor is not None and self.monitor.enabled:
+            self.monitor.write_events(
+                [("Train/Samples/train_loss", float(loss),
+                  self.global_samples)])
+        self.tput_timer.stop(global_step=True)
+        return loss
 
     def eval_batch(self, batch: Dict[str, Any]):
         batch = dict(batch)

@@ -43,7 +43,10 @@ DEFAULT_STRATEGIES = (
     (r"(c_attn|query_key_value|qkv).*(kernel|weight|bias)$", "qkv", -1),
     (r"(c_fc|fc1|dense_h_to_4h|w1).*(kernel|weight|bias)$", "column", -1),
     (r"(c_proj|fc2|dense_4h_to_h|w2).*(kernel|weight)$", "row", 0),
-    (r"(wte|embedding|word_embeddings)", "column", 0),
+    # position/type tables are TP-replicated (models/*.py sharding rules);
+    # only the token-embedding table is vocab-sharded
+    (r"(wpe|position_embeddings|token_type_embeddings)", "replicate", None),
+    (r"(wte|word_embeddings)", "column", 0),
     (r".*", "replicate", None),
 )
 

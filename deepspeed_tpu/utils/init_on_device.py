@@ -16,9 +16,11 @@ import jax.numpy as jnp
 
 
 class OnDevice:
-    """Context manager + helpers for abstract-then-materialize init."""
+    """Context manager + helpers for abstract-then-materialize init.
 
-    _active: Optional["OnDevice"] = None
+    The ``with`` form mirrors the reference's spelling; all behavior is
+    explicit through ``ctx.init`` / ``materialize`` (nothing is globally
+    intercepted — JAX needs no monkey-patching to defer allocation)."""
 
     def __init__(self, dtype=jnp.float32, device: str = "meta",
                  enabled: bool = True):
@@ -27,12 +29,9 @@ class OnDevice:
         self.enabled = enabled
 
     def __enter__(self):
-        if self.enabled:
-            OnDevice._active = self
         return self
 
     def __exit__(self, *exc):
-        OnDevice._active = None
         return False
 
     # ------------------------------------------------------------------

@@ -182,14 +182,12 @@ class ThroughputTimer:
                 # fenced wall time between them
                 _sync()
                 now = time.time()
+                curr = 0.0
                 if self._fence_epoch_time is not None:
                     span = now - self._fence_epoch_time
                     steps = self.global_step_count - self._fence_epoch_step
-                    curr = (self.batch_size * steps / span) if span > 0 \
-                        else 0.0
-                else:
-                    curr = 0.0
-                if self._fence_epoch_time is not None:
+                    if span > 0:
+                        curr = self.batch_size * steps / span
                     self._fenced_total_time += span
                     self._fenced_total_steps += steps
                 self._fence_epoch_time = now
@@ -208,13 +206,11 @@ class ThroughputTimer:
             self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self):
-        # fenced boundary-to-boundary accounting when available (exact);
-        # falls back to accumulated host durations before the first report
+        # fenced boundary-to-boundary accounting only: before the first
+        # fenced interval the host-side durations are dispatch-only and
+        # would overreport by orders of magnitude — return 0 ("no honest
+        # measurement yet") instead
         if self._fenced_total_time > 0:
             return (self.batch_size * self._fenced_total_steps
                     / self._fenced_total_time)
-        if self.global_step_count > self.start_step:
-            samples = self.batch_size * (self.global_step_count - self.start_step)
-            if self.total_elapsed_time > 0:
-                return samples / self.total_elapsed_time
         return 0.0
