@@ -211,6 +211,7 @@ class DeepSpeedEngine:
         # optimizer + schedule
 
         self.lr_scheduler, self._schedule_fn = self._configure_lr(lr_scheduler)
+        self._client_optimizer = optimizer
         self._tx = self._configure_optimizer(optimizer)
         self.optimizer_adapter = OptimizerAdapter(self)
 
@@ -226,6 +227,11 @@ class DeepSpeedEngine:
         self._initialized = False
         self._rng = jax.random.PRNGKey(seed)
         self._unit_scale = jnp.float32(1.0)
+        # ZeRO-Offload (reference zero cpu_offload / ZeRO-Infinity nvme)
+        off_cfg = config.zero_config.offload_optimizer or {}
+        self._offload_device = off_cfg.get("device", "none")
+        self._offload_opt = None
+        self._zero_acc_fn = None
 
         # host counters
         self.micro_steps = 0
@@ -338,16 +344,46 @@ class DeepSpeedEngine:
         param_shapes = jax.eval_shape(init_fn, init_rngs)
         self._param_shardings = self.sharding_rules.param_sharding_tree(param_shapes)
         self._grad_shardings = self.sharding_rules.grad_sharding_tree(param_shapes)
+        self._compute_dtype = jax.tree.leaves(param_shapes)[0].dtype
 
         t0 = time.time()
         self._params = jax.jit(init_fn, out_shardings=self._param_shardings)(init_rngs)
-        opt_shapes = jax.eval_shape(self._tx.init, param_shapes)
-        self._opt_shardings = self.sharding_rules.opt_sharding_tree(
-            opt_shapes, param_shapes
-        )
-        self._opt_state = jax.jit(
-            self._tx.init, out_shardings=self._opt_shardings
-        )(self._params)
+        if self._offload_device in ("cpu", "nvme"):
+            # ZeRO-Offload: fp32 masters + moments on host (zero/offload.py)
+            # — no device optimizer state is ever allocated
+            from deepspeed_tpu.runtime.zero.offload import \
+                HostOffloadOptimizer
+
+            if self._client_optimizer is not None:
+                raise ValueError(
+                    "offload_optimizer cannot honor a client optax "
+                    "optimizer: the host step runs the native fused Adam; "
+                    "configure the optimizer via the config block or "
+                    "disable offload")
+            opt_type = (self._config.optimizer.type or "adamw").lower()
+            if opt_type not in ("adam", "adamw", "fusedadam"):
+                raise NotImplementedError(
+                    f"offload_optimizer supports adam-family optimizers "
+                    f"(cpu_adam kernel); got {self._config.optimizer.type}")
+            off = self._config.zero_config.offload_optimizer or {}
+            self._offload_opt = HostOffloadOptimizer(
+                self._params, self._param_shardings,
+                self._config.optimizer.params,
+                compute_dtype=self._compute_dtype,
+                gradient_clipping=self.gradient_clipping or 0.0,
+                lr_schedule=self._schedule_fn,
+                nvme_dir=(off.get("nvme_path", "/local_nvme")
+                          if self._offload_device == "nvme" else None))
+            self._opt_shardings = None
+            self._opt_state = None
+        else:
+            opt_shapes = jax.eval_shape(self._tx.init, param_shapes)
+            self._opt_shardings = self.sharding_rules.opt_sharding_tree(
+                opt_shapes, param_shapes
+            )
+            self._opt_state = jax.jit(
+                self._tx.init, out_shardings=self._opt_shardings
+            )(self._params)
         self._acc_grads = jax.jit(
             lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p),
             out_shardings=self._grad_shardings,
@@ -545,17 +581,7 @@ class DeepSpeedEngine:
         ``backward()`` commits them — same cost, same calling convention."""
         batch = dict(batch)
         if self.curriculum_scheduler is not None:
-            # truncate sequence tensors to the scheduled difficulty
-            # (reference injects curriculum_seqlen and slices in the model;
-            # slicing here keeps one compiled program per difficulty value)
-            seqlen = self.curriculum_scheduler.update_difficulty(
-                self.global_steps + 1)
-            batch = {
-                k: (v[:, :seqlen]
-                    if getattr(v, "ndim", 0) >= 2 and v.shape[1] > seqlen
-                    else v)
-                for k, v in batch.items()
-            }
+            batch = self._apply_curriculum(batch)
         if not self._initialized:
             self._init_state(batch)
         if self._fwd_bwd_fn is None:
@@ -597,6 +623,24 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
 
+    def _take_offload_step(self):
+        """Host optimizer step (ZeRO-Offload): grads to host, native fused
+        Adam over fp32 masters, compute-dtype params back to device."""
+        scale = float(self._ls_state.scale) if self.fp16_enabled else 1.0
+        self._params, overflow, _grad_norm = self._offload_opt.step(
+            self._acc_grads, loss_scale=scale,
+            global_step=self.global_steps)
+        if self._zero_acc_fn is None:
+            self._zero_acc_fn = jax.jit(
+                lambda g: jax.tree.map(jnp.zeros_like, g),
+                donate_argnums=(0,),
+                out_shardings=self._grad_shardings)
+        self._acc_grads = self._zero_acc_fn(self._acc_grads)
+        if self.fp16_enabled:
+            self._ls_state = update_loss_scale(
+                self._ls_state, jnp.bool_(overflow), self._ls_config)
+        return jnp.bool_(overflow)
+
     def backward(self, loss=None):
         """Record the micro-step loss (reference engine.py:1764; the gradient
         computation already ran fused with ``forward`` — JAX has no separate
@@ -624,17 +668,30 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=at_boundary)
 
     def _take_model_step(self):
-        if self._apply_fn is None:
-            self._apply_fn = self._build_apply()
         if self.wall_clock_breakdown:
             self.timers(STEP_MICRO_TIMER).start()
-        (
-            self._params, self._opt_state, self._acc_grads,
-            self._ls_state, overflow, grad_norm,
-        ) = self._apply_fn(
-            self._params, self._opt_state, self._acc_grads, self._ls_state
-        )
+        if self._offload_opt is not None:
+            overflow = self._take_offload_step()
+        else:
+            if self._apply_fn is None:
+                self._apply_fn = self._build_apply()
+            (
+                self._params, self._opt_state, self._acc_grads,
+                self._ls_state, overflow, grad_norm,
+            ) = self._apply_fn(
+                self._params, self._opt_state, self._acc_grads,
+                self._ls_state
+            )
         self.global_steps += 1
+        self._post_step_bookkeeping(overflow, self._step_losses)
+        self._step_losses = []
+        if self.wall_clock_breakdown:
+            self.timers(STEP_MICRO_TIMER).stop()
+            self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER])
+
+    def _post_step_bookkeeping(self, overflow, step_losses):
+        """Host tail shared by the fused and unfused step paths: overflow
+        accounting, lr schedule, PLD, MoQ, progress + monitor events."""
         if self.fp16_enabled and bool(overflow):
             self.skipped_steps += 1
             log_dist(
@@ -658,18 +715,29 @@ class DeepSpeedEngine:
                 self._reshard_params_fn = jax.jit(
                     lambda t: t, out_shardings=self._param_shardings)
             self._params = self._reshard_params_fn(quantized)
-        if self.wall_clock_breakdown:
-            self.timers(STEP_MICRO_TIMER).stop()
-            self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER])
         if self.global_steps % self._config.steps_per_print == 0:
             self._report_progress()
-        if self.monitor is not None and self._step_losses:
+        # gate on enabled BEFORE the float() conversions: pulling the loss
+        # to host costs a device sync per step
+        if (self.monitor is not None
+                and getattr(self.monitor, "enabled", True) and step_losses):
             self.monitor.write_events(
                 [("Train/Samples/train_loss",
-                  float(np.mean([float(l) for l in self._step_losses])),
+                  float(np.mean([float(l) for l in step_losses])),
                   self.global_samples)]
             )
-        self._step_losses = []
+
+    def _apply_curriculum(self, batch):
+        """Truncate sequence tensors to the scheduled difficulty (one
+        compiled program per distinct value)."""
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        return {
+            k: (v[:, :seqlen]
+                if getattr(v, "ndim", 0) >= 2 and v.shape[1] > seqlen
+                else v)
+            for k, v in batch.items()
+        }
 
     def train_batch(self, data_iter):
         """Full effective-batch step: gas micro steps + model update
@@ -678,7 +746,8 @@ class DeepSpeedEngine:
         compiled program (fwd+bwd+optimizer)."""
         if (self.gradient_accumulation_steps == 1
                 and not self._config.flops_profiler.enabled
-                and not self.wall_clock_breakdown):
+                and not self.wall_clock_breakdown
+                and self._offload_device == "none"):
             return self._train_batch_fused(next(data_iter))
         losses = []
         for _ in range(self.gradient_accumulation_steps):
@@ -692,14 +761,7 @@ class DeepSpeedEngine:
     def _train_batch_fused(self, batch):
         batch = dict(batch)
         if self.curriculum_scheduler is not None:
-            seqlen = self.curriculum_scheduler.update_difficulty(
-                self.global_steps + 1)
-            batch = {
-                k: (v[:, :seqlen]
-                    if getattr(v, "ndim", 0) >= 2 and v.shape[1] > seqlen
-                    else v)
-                for k, v in batch.items()
-            }
+            batch = self._apply_curriculum(batch)
         if not self._initialized:
             self._init_state(batch)
         if self._train_step_fn is None:
@@ -718,34 +780,7 @@ class DeepSpeedEngine:
             self.train_micro_batch_size_per_gpu
             * self.topology.data_parallel_size)
 
-        # same host bookkeeping as _take_model_step; bool(overflow) forces
-        # a sync so it is gated on fp16 exactly like the unfused path
-        if self.fp16_enabled and bool(overflow):
-            self.skipped_steps += 1
-            log_dist(
-                f"overflow at step {self.global_steps}; loss scale -> "
-                f"{float(self._ls_state.scale)}", ranks=[0])
-        elif self.lr_scheduler is not None:
-            self.lr_scheduler.step()
-        if self.progressive_layer_drop is not None:
-            self.progressive_layer_drop.update_state(self.global_steps)
-        if self.quantizer is not None:
-            self._rng, qrng = jax.random.split(self._rng)
-            quantized = self.quantizer.quantize(
-                self._params,
-                overflow=self.fp16_enabled and bool(overflow),
-                eigenvalue_enabled=self.quantizer.q_eigenvalue,
-                rng=qrng)
-            if self._reshard_params_fn is None:
-                self._reshard_params_fn = jax.jit(
-                    lambda t: t, out_shardings=self._param_shardings)
-            self._params = self._reshard_params_fn(quantized)
-        if self.global_steps % self._config.steps_per_print == 0:
-            self._report_progress()
-        if self.monitor is not None and self.monitor.enabled:
-            self.monitor.write_events(
-                [("Train/Samples/train_loss", float(loss),
-                  self.global_samples)])
+        self._post_step_bookkeeping(overflow, [loss])
         self.tput_timer.stop(global_step=True)
         return loss
 
@@ -827,7 +862,9 @@ class DeepSpeedEngine:
         with open(self._engine_states_path(save_dir, tag), "wb") as f:
             pickle.dump(meta, f)
         optim_state = {
-            "optimizer": serialization.to_state_dict(self._opt_state),
+            "optimizer": (self._offload_opt.state_dict()
+                          if self._offload_opt is not None
+                          else serialization.to_state_dict(self._opt_state)),
             "loss_scale": {
                 "scale": np.float32(self._ls_state.scale),
                 "good_steps": np.int32(self._ls_state.good_steps),
@@ -884,6 +921,11 @@ class DeepSpeedEngine:
         self._params = jax.jit(
             lambda t: t, out_shardings=self._param_shardings
         )(restored)
+        if self._offload_opt is not None and not load_optimizer_states:
+            # offload steps rebuild device params FROM the host masters, so
+            # restored weights must be copied into them (load_state_dict
+            # does this when optimizer states are loaded)
+            self._offload_opt.refresh_masters(self._params)
         self.global_steps = int(meta["global_steps"])
         self.global_samples = int(meta["global_samples"])
         self.micro_steps = int(meta["micro_steps"])
@@ -897,12 +939,15 @@ class DeepSpeedEngine:
             optim_state = self.checkpoint_engine.load(
                 self._optim_states_path(load_dir, tag)
             )
-            restored_opt = serialization.from_state_dict(
-                self._opt_state, optim_state["optimizer"]
-            )
-            self._opt_state = jax.jit(
-                lambda t: t, out_shardings=self._opt_shardings
-            )(restored_opt)
+            if self._offload_opt is not None:
+                self._offload_opt.load_state_dict(optim_state["optimizer"])
+            else:
+                restored_opt = serialization.from_state_dict(
+                    self._opt_state, optim_state["optimizer"]
+                )
+                self._opt_state = jax.jit(
+                    lambda t: t, out_shardings=self._opt_shardings
+                )(restored_opt)
             ls = optim_state.get("loss_scale", {})
             if ls and self._ls_state is not None:
                 self._ls_state = self._ls_state._replace(
