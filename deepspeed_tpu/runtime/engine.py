@@ -629,7 +629,7 @@ class DeepSpeedEngine:
         scale = float(self._ls_state.scale) if self.fp16_enabled else 1.0
         self._params, overflow, _grad_norm = self._offload_opt.step(
             self._acc_grads, loss_scale=scale,
-            global_step=self.global_steps)
+            global_step=self.global_steps, current_params=self._params)
         if self._zero_acc_fn is None:
             self._zero_acc_fn = jax.jit(
                 lambda g: jax.tree.map(jnp.zeros_like, g),

@@ -77,7 +77,7 @@ class HostOffloadOptimizer:
         self._swapper.wait()
 
     def _swap_out_moments(self):
-        if self._swapper is None:
+        if self._swapper is None or not self.cpu_adam._m:
             return
         for i in range(len(self.masters)):
             self._swapper.swap_out(f"m{i}", self.cpu_adam._m[i])
@@ -88,9 +88,11 @@ class HostOffloadOptimizer:
 
     # ------------------------------------------------------------------
     def step(self, acc_grads, loss_scale: float = 1.0,
-             global_step: int = 0):
+             global_step: int = 0, current_params=None):
         """Host optimizer step. Returns (new device params tree, overflow,
-        grad_norm)."""
+        grad_norm). On overflow the masters are untouched and
+        ``current_params`` (when given) is returned as-is — no redundant
+        full-model re-upload."""
         if self.lr_schedule is not None:
             self.cpu_adam.lr = float(self.lr_schedule(global_step))
 
@@ -103,6 +105,8 @@ class HostOffloadOptimizer:
         grad_norm = float(np.sqrt(sq))
         overflow = not np.isfinite(grad_norm)
 
+        if overflow and current_params is not None:
+            return current_params, overflow, grad_norm
         if not overflow:
             if self.gradient_clipping and self.gradient_clipping > 0:
                 factor = min(1.0,
@@ -118,9 +122,11 @@ class HostOffloadOptimizer:
         for m, shape, dtype, shard in zip(self.masters, self._shapes,
                                           self._dtypes,
                                           self._shard_leaves):
-            arr = jnp.asarray(m.reshape(shape), dtype=dtype)
-            if shard is not None:
-                arr = jax.device_put(arr, shard)
+            # one transfer: cast on HOST (jax registers bf16 with numpy)
+            # then device_put straight into the target sharding
+            host = m.reshape(shape).astype(np.dtype(dtype), copy=False)
+            arr = jax.device_put(host, shard) if shard is not None \
+                else jnp.asarray(host)
             device_leaves.append(arr)
         return (jax.tree.unflatten(self._treedef, device_leaves),
                 overflow, grad_norm)
@@ -138,24 +144,34 @@ class HostOffloadOptimizer:
     # ------------------------------------------------------------------
     def state_dict(self):
         self._swap_in_moments()
-        return {
+        # moments are stored ONLY when they exist (no sentinel values — a
+        # zeros(1) placeholder would collide with genuine size-1 params)
+        sd = {
             "step_count": self.cpu_adam.step_count,
             "masters": {str(i): m for i, m in enumerate(self.masters)},
-            "exp_avg": {str(i): self.cpu_adam._m.get(i, np.zeros(1))
-                        for i in range(len(self.masters))},
-            "exp_avg_sq": {str(i): self.cpu_adam._v.get(i, np.zeros(1))
-                           for i in range(len(self.masters))},
+            "exp_avg": {str(i): m for i, m in self.cpu_adam._m.items()},
+            "exp_avg_sq": {str(i): v
+                           for i, v in self.cpu_adam._v.items()},
         }
+        # restore the nvme-tier invariant (host RAM holds only masters)
+        self._swap_out_moments()
+        return sd
 
     def load_state_dict(self, sd):
         self.cpu_adam.step_count = int(sd["step_count"])
+        # drop resident moments first so a pre-first-step checkpoint
+        # (no stored moments) cannot leave stale state behind
+        self.cpu_adam._m.clear()
+        self.cpu_adam._v.clear()
         for i in range(len(self.masters)):
             self.masters[i][...] = np.asarray(
                 sd["masters"][str(i)], dtype=np.float32).reshape(
                     self.masters[i].shape)
-            m = np.asarray(sd["exp_avg"][str(i)], dtype=np.float32)
-            v = np.asarray(sd["exp_avg_sq"][str(i)], dtype=np.float32)
-            if m.size == self.masters[i].size:
-                self.cpu_adam._m[i] = m.reshape(-1).copy()
-                self.cpu_adam._v[i] = v.reshape(-1).copy()
+            key = str(i)
+            if key in sd.get("exp_avg", {}):
+                self.cpu_adam._m[i] = np.asarray(
+                    sd["exp_avg"][key], dtype=np.float32).reshape(-1).copy()
+                self.cpu_adam._v[i] = np.asarray(
+                    sd["exp_avg_sq"][key],
+                    dtype=np.float32).reshape(-1).copy()
         self._swap_out_moments()

@@ -75,3 +75,25 @@ class TestZeroOffload:
         assert not engine._offload_opt.cpu_adam._m
         losses = [float(engine.train_batch(it)) for _ in range(8)]
         assert losses[-1] < losses[0], losses
+
+    def test_checkpoint_before_first_step(self, tmp_path, eight_devices):
+        """A checkpoint saved before any optimizer step (placeholder
+        moments) must restore cleanly in both cpu and nvme modes."""
+        engine, it = make_engine("cpu")
+        engine.forward(next(it))  # materialize state, no step taken
+        engine.backward()
+        engine.save_checkpoint(str(tmp_path), tag="t0")
+
+        engine2, it2 = make_engine("cpu")
+        for _ in range(3):
+            engine2.train_batch(it2)  # non-empty moments before load
+        engine2.load_checkpoint(str(tmp_path))
+        assert engine2._offload_opt.cpu_adam.step_count == 0
+        assert not engine2._offload_opt.cpu_adam._m  # stale moments dropped
+        assert np.isfinite(float(engine2.train_batch(it2)))
+
+        engine3, it3 = make_engine(
+            "nvme", nvme_path=str(tmp_path / "swap"))
+        engine3.train_batch(it3)
+        engine3.load_checkpoint(str(tmp_path))  # must not KeyError
+        assert np.isfinite(float(engine3.train_batch(it3)))

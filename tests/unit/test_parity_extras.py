@@ -230,3 +230,46 @@ class TestOnebitVariants:
     def test_zoadam_requires_axis_size(self):
         with pytest.raises(ValueError):
             zero_one_adam(1e-2)
+
+
+# ---------------------------------------------------------------------------
+# runtime utils
+# ---------------------------------------------------------------------------
+from deepspeed_tpu.runtime.utils import (
+    CheckOverflow,
+    call_to_str,
+    clip_grad_norm_,
+    get_global_norm,
+    partition_balanced,
+    partition_uniform,
+    see_memory_usage,
+)
+
+
+class TestRuntimeUtils:
+    def test_global_norm_and_clip(self):
+        g = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.zeros(2)}
+        assert float(get_global_norm(g)) == pytest.approx(5.0)
+        clipped, norm = clip_grad_norm_(g, max_norm=1.0)
+        assert float(norm) == pytest.approx(5.0)
+        assert float(get_global_norm(clipped)) == pytest.approx(1.0,
+                                                               rel=1e-4)
+        # inf norm
+        assert float(get_global_norm(g, float("inf"))) == 4.0
+
+    def test_check_overflow(self):
+        ok = {"a": jnp.ones(4)}
+        bad = {"a": jnp.asarray([1.0, np.inf])}
+        assert not bool(CheckOverflow.has_overflow(ok))
+        assert bool(CheckOverflow.has_overflow(bad))
+
+    def test_partitioners(self):
+        assert partition_uniform(10, 3)[-1] == 10
+        parts = partition_balanced([1, 1, 8, 1, 1], 2)
+        assert parts[0] == 0 and parts[-1] == 5
+
+    def test_memory_and_str(self):
+        out = see_memory_usage("probe", force=True)
+        assert out is not None
+        assert see_memory_usage("skipped") is None
+        assert call_to_str("f", 1, k="v") == "f(1, k='v')"
