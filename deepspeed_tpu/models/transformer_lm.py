@@ -246,7 +246,14 @@ def _remat_policy(name: str):
     import jax
 
     if name == "selective":
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        # non-batched dots (the param matmuls) + flash-attention outputs:
+        # saving o/lse (O(seq) memory) avoids re-running the fwd kernel to
+        # rebuild backward residuals — attention probs are never saved
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse"),
+        )
     if name == "full":
         return None  # save nothing, recompute all
     raise ValueError(f"unknown remat_policy {name!r}")

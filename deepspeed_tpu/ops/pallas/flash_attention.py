@@ -40,7 +40,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     nk = t // block_k
     qi = pl.program_id(1)
 
-    q = q_ref[...].astype(jnp.float32) * scale  # [bq, d]
+    # keep MXU operands in the input dtype (bf16): f32xf32 dots fall off the
+    # systolic array's fast path; accumulate in f32 via preferred_element_type
+    q = q_ref[...]  # [bq, d]
     m = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
@@ -49,9 +51,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bq, block_k]
@@ -64,7 +66,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
@@ -121,16 +123,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     nk = t // block_k
     qi = pl.program_id(1)
 
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[...][:, :1]
     delta = delta_ref[...][:, :1]
     dq = jnp.zeros((bq, d), jnp.float32)
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(j, dq):
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -142,7 +144,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k_blk.dtype)
         return dq + scale * jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -160,8 +162,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     nq = t // block_q
     ki = pl.program_id(1)
 
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
     dk = jnp.zeros((bk, d), jnp.float32)
     dv = jnp.zeros((bk, d), jnp.float32)
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
@@ -169,8 +171,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(i, carry):
         dk, dv = carry
         j = i + (ki * bk) // block_q if causal else i
-        q_blk = q_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[pl.ds(j * block_q, block_q), :]
+        do_blk = do_ref[pl.ds(j * block_q, block_q), :]
         lse_blk = lse_ref[pl.ds(j * block_q, block_q), :1]
         delta_blk = delta_ref[pl.ds(j * block_q, block_q), :1]
         s = scale * jax.lax.dot_general(
@@ -181,13 +183,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse_blk)
+        pb = p.astype(do_blk.dtype)
         dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            pb, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk)
+        ds = (p * (dp - delta_blk)).astype(q_blk.dtype)
         dk = dk + scale * jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -274,7 +277,14 @@ def _flash(q, k, v, scale, causal, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
+
     o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    # under remat, tagging the kernel outputs lets a names-aware policy keep
+    # them (o: 2 bytes/elem, lse: 1/head_dim of that) instead of re-running
+    # the whole forward kernel to regenerate residuals in the backward pass
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -282,7 +292,7 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 512, block_k: int = 512):
     """Blockwise attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     Memory is O(seq) per program instead of O(seq^2); the [T, T] score matrix

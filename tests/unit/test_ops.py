@@ -77,6 +77,36 @@ class TestFlashAttention:
         assert out.dtype == jnp.bfloat16
         assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_bf16_grads_match_f32_reference(self, causal):
+        """bf16 operand path (MXU dtype, p/ds downcasts in all three
+        kernels): gradients must track the f32 reference within bf16
+        precision — guards downcast placement and the f32 accumulators."""
+        rng = jax.random.PRNGKey(3)
+        kq, kk, kv = jax.random.split(rng, 3)
+        shape = (2, 128, 2, 32)
+        qf = jax.random.normal(kq, shape, jnp.float32)
+        kf = jax.random.normal(kk, shape, jnp.float32)
+        vf = jax.random.normal(kv, shape, jnp.float32)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=32, block_k=32)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_ref_attention(q, k, v, causal=causal) ** 2)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+        for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+            gf = np.asarray(gf.astype(jnp.float32))
+            gr = np.asarray(gr)
+            # bf16 has ~3 decimal digits; compare on relative L2 error
+            rel = np.linalg.norm(gf - gr) / np.linalg.norm(gr)
+            assert rel < 0.03, f"d{name} rel L2 error {rel:.4f}"
+
 
 class TestFusedAdam:
     def test_single_update_matches_optax(self):
