@@ -381,13 +381,21 @@ class GPT(nn.Module):
         # tied LM head: bf16 operands + fp32 accumulation keeps the MXU at
         # full rate (a plain fp32 matmul here runs ~8x slower and is ~1/3
         # of the model's flops at this vocab size)
+        if labels is None:
+            logits = jax.lax.dot_general(
+                x.astype(cfg.dtype), wte.embedding.astype(cfg.dtype),
+                (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return logits
+        # training path: keep logits in the compute dtype and run the fused
+        # CE (f32 reductions inside the fusion, bf16 cotangent) — never
+        # materializes an f32 [tokens, vocab] buffer. The shift is expressed
+        # by zero-weighting the last position instead of slicing, which
+        # keeps every tensor tile-aligned (a [b, t-1, V] slice forces
+        # padded-tile reductions and a copy)
         logits = jax.lax.dot_general(
             x.astype(cfg.dtype), wte.embedding.astype(cfg.dtype),
-            (((x.ndim - 1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-        if labels is None:
-            return logits
+            (((x.ndim - 1,), (1,)), ((), ())))
         loss = cross_entropy_loss(logits, labels, attention_mask)
         if cfg.is_moe:
             # load-balance aux loss, averaged over layers (reference adds the
@@ -397,15 +405,30 @@ class GPT(nn.Module):
 
 
 def cross_entropy_loss(logits, labels, mask=None):
-    """Mean next-token cross entropy in fp32 with shift."""
-    logits = logits[:, :-1].astype(jnp.float32)
-    targets = labels[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    """Mean next-token cross entropy with shift (f32 reductions fused over
+    compute-dtype logits; see ops/cross_entropy.py).
+
+    The shift is expressed with shifted targets + a zero weight on the last
+    position rather than slicing logits to [b, t-1, V]: all tensors stay
+    tile-aligned and the flatten below is a free bitcast.
+    """
+    from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
+
+    b, t = labels.shape
+    # target for position i is labels[i+1]; last position gets a dummy
+    targets = jnp.concatenate(
+        [labels[:, 1:], jnp.zeros((b, 1), labels.dtype)], axis=1)
     if mask is not None:
-        m = mask[:, 1:].astype(jnp.float32)
-        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
-    return jnp.mean(nll)
+        w = mask.astype(jnp.float32)
+        w = jnp.concatenate(
+            [w[:, 1:], jnp.zeros((b, 1), jnp.float32)], axis=1)
+    else:
+        w = jnp.concatenate(
+            [jnp.ones((b, t - 1), jnp.float32),
+             jnp.zeros((b, 1), jnp.float32)], axis=1)
+    flat = logits.reshape(b * t, logits.shape[-1])
+    return softmax_cross_entropy(flat, targets.reshape(b * t),
+                                 w.reshape(b * t))
 
 
 def num_params(config: GPTConfig) -> int:

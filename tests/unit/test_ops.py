@@ -108,6 +108,53 @@ class TestFlashAttention:
             assert rel < 0.03, f"d{name} rel L2 error {rel:.4f}"
 
 
+class TestSoftmaxCrossEntropy:
+    def _ref(self, logits, targets, weights):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+    def test_matches_log_softmax_reference_f32(self):
+        from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (64, 257), jnp.float32) * 3.0
+        targets = jax.random.randint(jax.random.fold_in(rng, 1), (64,), 0, 257)
+        w = jnp.ones((64,), jnp.float32).at[:5].set(0.0)
+        got = softmax_cross_entropy(logits, targets, w)
+        ref = self._ref(logits, targets, w)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+
+    def test_grad_matches_reference_f32(self):
+        from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
+        rng = jax.random.PRNGKey(2)
+        logits = jax.random.normal(rng, (32, 129), jnp.float32) * 2.0
+        targets = jax.random.randint(jax.random.fold_in(rng, 1), (32,), 0, 129)
+        w = jnp.ones((32,), jnp.float32)
+        g = jax.grad(lambda l: softmax_cross_entropy(l, targets, w))(logits)
+        gr = jax.grad(lambda l: self._ref(l, targets, w))(logits)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_bf16_logits_grad_dtype_and_accuracy(self):
+        """The training path: bf16 logits in, bf16 cotangent out, f32 math
+        inside — loss and grads must track the f32 reference."""
+        from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
+        rng = jax.random.PRNGKey(3)
+        lf = jax.random.normal(rng, (128, 512), jnp.float32) * 4.0
+        lb = lf.astype(jnp.bfloat16)
+        targets = jax.random.randint(jax.random.fold_in(rng, 1), (128,), 0, 512)
+        w = jnp.ones((128,), jnp.float32)
+        loss_b = float(softmax_cross_entropy(lb, targets, w))
+        loss_f = float(self._ref(lf, targets, w))
+        assert abs(loss_b - loss_f) < 0.05
+        g = jax.grad(lambda l: softmax_cross_entropy(l, targets, w))(lb)
+        assert g.dtype == jnp.bfloat16
+        gr = jax.grad(lambda l: self._ref(l, targets, w))(lf)
+        gf = np.asarray(g.astype(jnp.float32))
+        rel = np.linalg.norm(gf - np.asarray(gr)) / np.linalg.norm(np.asarray(gr))
+        assert rel < 0.02, f"rel L2 error {rel:.4f}"
+
+
 class TestFusedAdam:
     def test_single_update_matches_optax(self):
         rng = jax.random.PRNGKey(0)
