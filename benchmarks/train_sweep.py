@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Ad-hoc perf sweep for the bench config (not part of the framework)."""
+import itertools
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config, num_params
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+seq = 1024
+
+
+def run(micro, remat, policy, flash):
+    cfg = gpt2_config(
+        "gpt2-125m", n_positions=seq, dtype=jnp.bfloat16, scan_layers=True,
+        remat=remat, remat_policy=policy, use_flash_attention=flash)
+    model = GPT(cfg)
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 6e-4, "betas": [0.9, 0.95],
+                                 "weight_decay": 0.1}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
+    gb = micro * engine.topology.data_parallel_size
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, cfg.vocab_size,
+                                      size=(gb, seq)).astype(np.int32)}
+    batch["labels"] = batch["input_ids"]
+    it = iter(RepeatingLoader([batch]))
+
+    def fence():
+        return float(jnp.sum(jax.tree.leaves(engine.params)[0]
+                             .astype(jnp.float32)))
+
+    try:
+        engine.train_batch(it)
+        engine.train_batch(it)
+        fence()
+        steps = 6
+        t0 = time.time()
+        for _ in range(steps):
+            engine.train_batch(it)
+        fence()
+        dt = (time.time() - t0) / steps
+    except Exception as e:  # OOM etc
+        print(json.dumps({"micro": micro, "remat": remat, "policy": policy,
+                          "flash": flash, "error": str(e)[:120]}), flush=True)
+        return
+    n_params = num_params(cfg)
+    embed = cfg.vocab_size * cfg.n_embd
+    attn = 6 * cfg.n_layer * cfg.n_embd * seq
+    fpt = 6.0 * (n_params - embed) + attn
+    tflops = gb * seq * fpt / dt / 1e12
+    print(json.dumps({"micro": micro, "remat": remat, "policy": policy,
+                      "flash": flash, "tflops": round(tflops, 2),
+                      "ms": round(dt * 1000, 1)}), flush=True)
+
+
+for micro, (remat, policy), flash in itertools.product(
+        [16, 32, 64],
+        [(False, "selective"), (True, "selective")],
+        [True]):
+    run(micro, remat, policy, flash)

@@ -66,6 +66,19 @@ class InferenceEngine:
                       "fp32": jnp.float32, "float32": jnp.float32,
                       "int8": jnp.int8}.get(dtype, dtype)
 
+        # HF torch model? Run the injection policy: convert weights into the
+        # equivalent flax model (reference replace_transformer_layer,
+        # module_inject/replace_module.py:277 — there it swaps fused CUDA
+        # modules in; here the flax model IS the fused path)
+        from deepspeed_tpu.module_inject.hf import import_hf_model, is_hf_model
+
+        hf_params = None
+        if is_hf_model(model):
+            compute = self.dtype if self.dtype in (
+                jnp.float16, jnp.bfloat16, jnp.float32) else jnp.bfloat16
+            self.module, hf_params = import_hf_model(model, dtype=compute)
+            model = self.module
+
         # injection policy -> TP sharding rules (reference
         # _apply_injection_policy, inference/engine.py:364)
         rules = policy_for(model) if config.get(
@@ -74,7 +87,8 @@ class InferenceEngine:
             self.topology, stage=0, tp_rules=rules)
 
         self._rng = jax.random.PRNGKey(seed)
-        self._params = None
+        self._params = (None if hf_params is None
+                        else jax.tree.map(jnp.asarray, hf_params))
         self._prefill_fn = None
         self._decode_fn = None
         self._fwd_fn = None

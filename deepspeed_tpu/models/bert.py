@@ -24,6 +24,9 @@ class BertConfig:
     num_hidden_layers: int = 12
     num_attention_heads: int = 12
     intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12   # HF BERT default
+    approximate_gelu: bool = True   # tanh gelu; HF BERT uses exact erf gelu
+    use_mlm_bias: bool = False      # HF cls.predictions.bias on the decoder
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -83,14 +86,14 @@ class BertLayer(nn.Module):
         cfg = self.config
         # Post-LN like original BERT
         a = BertSelfAttention(cfg, name="attention")(x, mask, deterministic)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x + a)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_attn")(x + a)
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="intermediate")(x)
-        h = nn.gelu(h, approximate=True)
+        h = nn.gelu(h, approximate=cfg.approximate_gelu)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="output")(h)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_out")(x + h)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_out")(x + h)
         return x
 
 
@@ -167,7 +170,7 @@ class BertForPreTraining(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         x = tok(input_ids) + pos(jnp.arange(T)[None, :]) + typ(token_type_ids)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="embeddings_ln")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="embeddings_ln")(x)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         x = BertEncoder(cfg, name="encoder")(x, attention_mask, deterministic)
@@ -175,14 +178,18 @@ class BertForPreTraining(nn.Module):
         # MLM transform + tied decoder
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="mlm_dense")(x)
-        h = nn.gelu(h, approximate=True)
-        h = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")(h)
+        h = nn.gelu(h, approximate=cfg.approximate_gelu)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="mlm_ln")(h)
         # bf16 operands + fp32 accumulation: full MXU rate on the vocab
         # projection (fp32 matmul would run ~8x slower)
         logits = jax.lax.dot_general(
             h.astype(cfg.dtype), tok.embedding.astype(cfg.dtype),
             (((h.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if cfg.use_mlm_bias:
+            logits = logits + self.param(
+                "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,),
+                cfg.param_dtype).astype(logits.dtype)
 
         if labels is None:
             return logits

@@ -31,9 +31,29 @@ class GPTConfig:
     n_layer: int = 12
     n_head: int = 12
     mlp_ratio: int = 4
+    layer_norm_epsilon: float = 1e-5  # HF GPT-2 default
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # --- architecture family knobs (GPT-2 defaults) ------------------------
+    # GPT-J/NeoX/OPT/LLaMA-family variants are the same block with these
+    # toggled; the HF injection policies (module_inject/hf.py) set them
+    intermediate_size: Optional[int] = None  # None -> mlp_ratio * n_embd
+    norm: str = "layernorm"            # "layernorm" | "rmsnorm" (LLaMA)
+    activation: str = "gelu_tanh"      # "gelu_tanh"|"gelu"|"relu"|"silu"
+    gated_mlp: bool = False            # SwiGLU: act(gate) * up (LLaMA)
+    use_bias: bool = True              # biases on dense + norm layers
+    attn_bias: Optional[bool] = None   # override for attention projections
+                                       # (GPT-J: biasless attn, biased MLP)
+    rotary: bool = False               # rotary embeddings (ops/rotary.py)
+    rotary_pct: float = 1.0            # fraction of head_dim rotated (NeoX)
+    rotary_interleaved: bool = False   # GPT-J even/odd pairing
+    rope_theta: float = 10000.0
+    learned_positions: bool = True     # wpe table (off for rotary models)
+    tie_word_embeddings: bool = True
+    lm_head_bias: bool = False         # GPT-J's untied head carries a bias
+    parallel_residual: bool = False    # x + attn(ln_1 x) + mlp(ln_2 x)
+    n_kv_head: Optional[int] = None    # grouped-query attention; None = MHA
     remat: bool = False
     # "full" recomputes everything (min memory); "selective" saves matmul
     # outputs and recomputes only elementwise ops — the TPU sweet spot:
@@ -60,10 +80,31 @@ class GPTConfig:
             raise ValueError(
                 f"sequence_parallel must be 'none', 'ring', or 'ulysses'; "
                 f"got {self.sequence_parallel!r}")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"unknown norm {self.norm!r}")
+        if self.activation not in ("gelu_tanh", "gelu", "relu", "silu"):
+            raise ValueError(f"unknown activation {self.activation!r}")
+        if self.n_kv_head is not None and self.n_head % self.n_kv_head:
+            raise ValueError(
+                f"n_head ({self.n_head}) must be divisible by n_kv_head "
+                f"({self.n_kv_head})")
 
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.intermediate_size or self.mlp_ratio * self.n_embd
+
+    @property
+    def rotary_dim(self) -> int:
+        rd = int(self.rotary_pct * self.head_dim)
+        return rd - rd % 2
 
     @property
     def is_moe(self) -> bool:
@@ -87,6 +128,23 @@ def gpt2_config(name: str, **overrides) -> GPTConfig:
     return GPTConfig(**base)
 
 
+def _norm(cfg, name):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, use_bias=cfg.use_bias,
+                        name=name)
+
+
+_ACTIVATIONS = {
+    "gelu_tanh": lambda x: nn.gelu(x, approximate=True),
+    "gelu": lambda x: nn.gelu(x, approximate=False),
+    "relu": nn.relu,
+    "silu": nn.silu,
+}
+
+
 class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
@@ -95,13 +153,27 @@ class CausalSelfAttention(nn.Module):
         cfg = self.config
         B, T, C = x.shape
         H, D = cfg.n_head, cfg.head_dim
+        Hkv = cfg.kv_heads
+        bias = cfg.use_bias if cfg.attn_bias is None else cfg.attn_bias
 
-        qkv = nn.Dense(3 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        qkv = nn.Dense((H + 2 * Hkv) * D, use_bias=bias,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        name="c_attn")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, D)
-        k = k.reshape(B, T, H, D)
-        v = v.reshape(B, T, H, D)
+        q = qkv[..., : H * D].reshape(B, T, H, D)
+        k = qkv[..., H * D:(H + Hkv) * D].reshape(B, T, Hkv, D)
+        v = qkv[..., (H + Hkv) * D:].reshape(B, T, Hkv, D)
+
+        def rope(t, positions):
+            from deepspeed_tpu.ops.rotary import apply_rotary_pos_emb
+
+            return apply_rotary_pos_emb(
+                t, positions, base=cfg.rope_theta,
+                rotary_dim=cfg.rotary_dim,
+                interleaved=cfg.rotary_interleaved)
+
+        def repeat_kv(t):
+            return (t if Hkv == H
+                    else jnp.repeat(t, H // Hkv, axis=2))
 
         if decode:
             # KV-cache append + attend (the reference's softmax_context
@@ -115,14 +187,20 @@ class CausalSelfAttention(nn.Module):
                     "left-trim prompts to equal length instead")
             cached_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (B, cfg.n_positions, H, D), cfg.dtype)
+                (B, cfg.n_positions, Hkv, D), cfg.dtype)
             cached_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (B, cfg.n_positions, H, D), cfg.dtype)
+                (B, cfg.n_positions, Hkv, D), cfg.dtype)
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
             idx = cache_index.value
+            if cfg.rotary:
+                # rotate before the cache write: cached keys are
+                # position-baked, exactly like the reference's KV cache
+                # after its apply_rotary_pos_emb kernel
+                pos = idx + jnp.arange(T)[None, :]
+                q, k = rope(q, pos), rope(k, pos)
             cached_k.value = jax.lax.dynamic_update_slice(
                 cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
             cached_v.value = jax.lax.dynamic_update_slice(
@@ -130,19 +208,30 @@ class CausalSelfAttention(nn.Module):
             cache_index.value = idx + T
             k_all, v_all = cached_k.value, cached_v.value
 
+            # grouped attention: query heads contract directly against the
+            # un-repeated KV cache ([B, max, Hkv, D] stays in place — no
+            # [B, max, H, D] repeat materializes per step)
+            G = H // Hkv
+            qg = q.reshape(B, T, Hkv, G, D)
             scale = 1.0 / np.sqrt(D)
-            att = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) * scale
+            att = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all) * scale
             q_pos = idx + jnp.arange(T)[:, None]            # [T, 1]
             k_pos = jnp.arange(cfg.n_positions)[None, :]    # [1, max]
             visible = k_pos <= q_pos                        # causal over cache
-            att = jnp.where(visible[None, None], att,
+            att = jnp.where(visible[None, None, None], att,
                             jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(
                 cfg.dtype)
-            y = jnp.einsum("bhqk,bkhd->bqhd", att, v_all)
+            y = jnp.einsum("bhgqk,bkhd->bqhgd", att, v_all)
             y = y.reshape(B, T, C)
-            return nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                            name="c_proj")(y)
+            return nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype, name="c_proj")(y)
+
+        if cfg.rotary:
+            q = rope(q, jnp.arange(T)[None, :])
+            k = rope(k, jnp.arange(T)[None, :])
+        k = repeat_kv(k)
+        v = repeat_kv(v)
 
         # like the flash path, sp attention has no attention-prob dropout
         if (cfg.sequence_parallel != "none" and mask is None
@@ -158,8 +247,8 @@ class CausalSelfAttention(nn.Module):
                            "ulysses": ulysses_attention}[cfg.sequence_parallel]
                 y = attn_fn(q, k, v, causal=True)
                 y = y.reshape(B, T, C)
-                y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                             name="c_proj")(y)
+                y = nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="c_proj")(y)
                 return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
 
         # flash path needs 128-aligned seq (TPU tile constraint), no padding
@@ -182,8 +271,8 @@ class CausalSelfAttention(nn.Module):
             att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         y = y.reshape(B, T, C)
-        y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                     name="c_proj")(y)
+        y = nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="c_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
 
@@ -194,10 +283,17 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, *, deterministic=True):
         cfg = self.config
-        h = nn.Dense(cfg.mlp_ratio * cfg.n_embd, dtype=cfg.dtype,
+        act = _ACTIVATIONS[cfg.activation]
+        h = nn.Dense(cfg.ffn_dim, use_bias=cfg.use_bias, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="c_fc")(x)
-        h = nn.gelu(h, approximate=True)
-        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
+        if cfg.gated_mlp:
+            # SwiGLU (LLaMA family): act(gate) * up — both column-parallel
+            g = nn.Dense(cfg.ffn_dim, use_bias=cfg.use_bias, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="c_gate")(x)
+            h = act(g) * h
+        else:
+            h = act(h)
+        h = nn.Dense(cfg.n_embd, use_bias=cfg.use_bias, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="c_proj")(h)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return h
@@ -213,10 +309,17 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, *, mask=None, deterministic=True, decode=False):
         cfg = self.config
-        x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x),
+        a = CausalSelfAttention(cfg, name="attn")(
+            _norm(cfg, "ln_1")(x),
             mask=mask, deterministic=deterministic, decode=decode)
-        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x)
+        if cfg.parallel_residual:
+            # GPT-J/NeoX form: attention and MLP both read the pre-residual
+            # stream; GPT-J's single shared LN is expressed by loading
+            # identical weights into ln_1/ln_2 (module_inject/hf.py)
+            h = _norm(cfg, "ln_2")(x)
+        else:
+            x = x + a
+            h = _norm(cfg, "ln_2")(x)
         if cfg.is_moe:
             from deepspeed_tpu.moe.layer import MoE
 
@@ -238,7 +341,7 @@ class Block(nn.Module):
         else:
             y = MLP(cfg, name="mlp")(h, deterministic=deterministic)
             l_aux = jnp.float32(0.0)
-        x = x + y
+        x = x + y + a if cfg.parallel_residual else x + y
         return x, l_aux
 
 
@@ -313,14 +416,16 @@ def gpt_tp_rules(path: str, shape) -> "PartitionSpec":
         return PartitionSpec(*spec)
 
     if path.endswith(("attn/c_attn/kernel", "mlp/c_fc/kernel",
-                      "attn/c_attn/bias", "mlp/c_fc/bias")):
+                      "mlp/c_gate/kernel",
+                      "attn/c_attn/bias", "mlp/c_fc/bias",
+                      "mlp/c_gate/bias")):
         return dim(-1)  # column parallel
     if path.endswith(("attn/c_proj/kernel", "mlp/c_proj/kernel")):
         return dim(-2)  # row parallel
     if path.endswith("wte/embedding"):
         return dim(0)   # vocab parallel (logits shard over vocab)
-    if path.endswith("lm_head/kernel"):
-        return dim(-1)  # vocab-parallel untied head (pipeline GPT)
+    if path.endswith(("lm_head/kernel", "lm_head")):
+        return dim(-1)  # vocab-parallel untied head
     # expert-parallel MoE params (ep axis + Megatron tp inside each expert)
     from deepspeed_tpu.moe.layer import moe_param_spec
 
@@ -344,17 +449,19 @@ class GPT(nn.Module):
         B, T = input_ids.shape
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wte")
-        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="wpe")
-        if decode:
-            # position offset tracked alongside the per-layer KV caches
-            position = self.variable("cache", "position",
-                                     lambda: jnp.zeros((), jnp.int32))
-            pos = position.value + jnp.arange(T)[None, :]
-            position.value = position.value + T
-        else:
-            pos = jnp.arange(T)[None, :]
-        x = wte(input_ids) + wpe(pos)
+        x = wte(input_ids)
+        if cfg.learned_positions:
+            wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
+                           param_dtype=cfg.param_dtype, name="wpe")
+            if decode:
+                # position offset tracked alongside the per-layer KV caches
+                position = self.variable("cache", "position",
+                                         lambda: jnp.zeros((), jnp.int32))
+                pos = position.value + jnp.arange(T)[None, :]
+                position.value = position.value + T
+            else:
+                pos = jnp.arange(T)[None, :]
+            x = x + wpe(pos)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         if cfg.scan_layers:
@@ -377,15 +484,29 @@ class GPT(nn.Module):
                                       attention_mask)
                 l_aux = l_aux + aux_i
 
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
-        # tied LM head: bf16 operands + fp32 accumulation keeps the MXU at
-        # full rate (a plain fp32 matmul here runs ~8x slower and is ~1/3
-        # of the model's flops at this vocab size)
+        x = _norm(cfg, "ln_f")(x)
+        # LM head (tied to wte, or a separate lm_head when untied): bf16
+        # operands + fp32 accumulation keeps the MXU at full rate (a plain
+        # fp32 matmul here runs ~8x slower and is ~1/3 of the model's flops
+        # at this vocab size)
+        if cfg.tie_word_embeddings:
+            head_w = wte.embedding.astype(cfg.dtype)  # [V, C]
+            head_dims = (((x.ndim - 1,), (1,)), ((), ()))
+        else:
+            head_w = self.param(
+                "lm_head",
+                nn.initializers.normal(0.02), (cfg.n_embd, cfg.vocab_size),
+                cfg.param_dtype).astype(cfg.dtype)    # [C, V]
+            head_dims = (((x.ndim - 1,), (0,)), ((), ()))
+        head_b = (self.param("lm_head_bias", nn.initializers.zeros,
+                             (cfg.vocab_size,), cfg.param_dtype)
+                  if cfg.lm_head_bias else None)
         if labels is None:
             logits = jax.lax.dot_general(
-                x.astype(cfg.dtype), wte.embedding.astype(cfg.dtype),
-                (((x.ndim - 1,), (1,)), ((), ())),
+                x.astype(cfg.dtype), head_w, head_dims,
                 preferred_element_type=jnp.float32)
+            if head_b is not None:
+                logits = logits + head_b.astype(logits.dtype)
             return logits
         # training path: keep logits in the compute dtype and run the fused
         # CE (f32 reductions inside the fusion, bf16 cotangent) — never
@@ -394,8 +515,9 @@ class GPT(nn.Module):
         # keeps every tensor tile-aligned (a [b, t-1, V] slice forces
         # padded-tile reductions and a copy)
         logits = jax.lax.dot_general(
-            x.astype(cfg.dtype), wte.embedding.astype(cfg.dtype),
-            (((x.ndim - 1,), (1,)), ((), ())))
+            x.astype(cfg.dtype), head_w, head_dims)
+        if head_b is not None:
+            logits = logits + head_b.astype(logits.dtype)
         loss = cross_entropy_loss(logits, labels, attention_mask)
         if cfg.is_moe:
             # load-balance aux loss, averaged over layers (reference adds the
@@ -432,10 +554,23 @@ def cross_entropy_loss(logits, labels, mask=None):
 
 
 def num_params(config: GPTConfig) -> int:
-    """Approximate parameter count (for flops accounting)."""
-    C, L, V, Pn = config.n_embd, config.n_layer, config.vocab_size, config.n_positions
-    per_layer = 12 * C * C + 13 * C
-    return V * C + Pn * C + L * per_layer + 2 * C
+    """Approximate parameter count (for flops accounting); tracks the
+    architecture-family knobs (GQA, gated MLP, untied head, biases)."""
+    cfg = config
+    C, L, V = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    D, H, Hkv, F = cfg.head_dim, cfg.n_head, cfg.kv_heads, cfg.ffn_dim
+    b = 1 if cfg.use_bias else 0
+    attn = C * (H + 2 * Hkv) * D + b * (H + 2 * Hkv) * D + C * C + b * C
+    mlp = (3 if cfg.gated_mlp else 2) * C * F + b * (
+        (2 if cfg.gated_mlp else 1) * F + C)
+    norm_p = C * (2 if (cfg.norm == "layernorm" and cfg.use_bias) else 1)
+    per_layer = attn + mlp + 2 * norm_p
+    total = V * C + L * per_layer + norm_p
+    if cfg.learned_positions:
+        total += cfg.n_positions * C
+    if not cfg.tie_word_embeddings:
+        total += C * V
+    return total
 
 
 def train_flops_per_token(config: GPTConfig) -> float:

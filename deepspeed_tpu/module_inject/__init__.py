@@ -37,3 +37,16 @@ def _builtin_policies():
 
 
 _builtin_policies()
+
+
+def __getattr__(name):
+    # HF checkpoint-import policies (heavy deps: torch/transformers) load
+    # lazily; ``from deepspeed_tpu.module_inject import import_hf_model``
+    _hf_api = ("import_hf_model", "is_hf_model", "gpt2_from_hf",
+               "bert_from_hf", "gptneox_from_hf", "gptj_from_hf",
+               "opt_from_hf", "llama_from_hf")
+    if name in _hf_api:
+        from deepspeed_tpu.module_inject import hf
+
+        return getattr(hf, name)
+    raise AttributeError(name)

@@ -25,10 +25,15 @@ def apply_rotary_pos_emb(
     positions: Optional[jnp.ndarray] = None,
     base: float = 10000.0,
     rotary_dim: Optional[int] = None,
+    interleaved: bool = False,
 ) -> jnp.ndarray:
-    """Rotate ``x: [batch, seq, heads, head_dim]`` (pairwise half-dim split,
-    the GPT-NeoX convention the reference's kernel implements with
-    rotate_half)."""
+    """Rotate ``x: [batch, seq, heads, head_dim]``.
+
+    ``interleaved=False``: pairwise half-dim split — the GPT-NeoX/LLaMA
+    convention the reference's kernel implements with rotate_half.
+    ``interleaved=True``: even/odd pairing — the GPT-J convention (the
+    reference kernel's ``rotate_every_two`` variant).
+    """
     b, t, h, d = x.shape
     rd = rotary_dim or d
     if positions is None:
@@ -38,9 +43,15 @@ def apply_rotary_pos_emb(
     sin = sin[:, :, None, :]
 
     x_rot, x_pass = x[..., :rd], x[..., rd:]
-    x1, x2 = x_rot[..., : rd // 2], x_rot[..., rd // 2:]
-    rotated = jnp.concatenate(
-        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:
+        x1, x2 = x_rot[..., ::2], x_rot[..., 1::2]
+        rotated = jnp.stack(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).reshape(x_rot.shape)
+    else:
+        x1, x2 = x_rot[..., : rd // 2], x_rot[..., rd // 2:]
+        rotated = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     if rd < d:
         return jnp.concatenate([rotated, x_pass], axis=-1)
     return rotated
