@@ -1,8 +1,10 @@
 #!/usr/bin/env python
-"""Benchmark: GPT-2 125M bf16 training throughput on one TPU chip.
+"""Benchmark: GPT-2 350M bf16 training throughput on one TPU chip.
 
-Mirrors BASELINE config 2 (GPT-2 125M, fused adam, bf16, DP) on the available
-hardware. Prints ONE JSON line:
+Mirrors the BASELINE GPT-2 training family (configs 2-3) on the available
+hardware: 350M is the largest GPT-2 size whose fp32 optimizer states fit
+this chip's HBM without offload, and sits between config 2 (125M) and the
+1.3B north star. 125M and other sizes: benchmarks/train_sweep.py. Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 vs_baseline normalizes achieved model TFLOPS against the reference's best
@@ -33,9 +35,9 @@ def main():
     )
 
     seq = 1024
-    micro = 16
+    micro = 8
     cfg = gpt2_config(
-        "gpt2-125m",
+        "gpt2-350m",
         n_positions=seq,
         dtype=jnp.bfloat16,
         scan_layers=True,
@@ -102,7 +104,7 @@ def main():
     samples_per_sec = gb / dt
 
     result = {
-        "metric": "gpt2_125m_bf16_train_tflops_per_chip",
+        "metric": "gpt2_350m_bf16_train_tflops_per_chip",
         "value": round(tflops, 2),
         "unit": "TFLOPS",
         "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),

@@ -2,10 +2,11 @@
 """Ad-hoc perf sweep for the bench config (not part of the framework)."""
 import itertools
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -69,8 +70,9 @@ def run(micro, remat, policy, flash):
                       "ms": round(dt * 1000, 1)}), flush=True)
 
 
-for micro, (remat, policy), flash in itertools.product(
-        [16, 32, 64],
-        [(False, "selective"), (True, "selective")],
-        [True]):
-    run(micro, remat, policy, flash)
+if __name__ == "__main__":
+    for micro, (remat, policy), flash in itertools.product(
+            [16, 32, 64],
+            [(False, "selective"), (True, "selective")],
+            [True]):
+        run(micro, remat, policy, flash)

@@ -103,7 +103,7 @@ class GPTConfig:
 
     @property
     def rotary_dim(self) -> int:
-        rd = int(self.rotary_pct * self.head_dim)
+        rd = round(self.rotary_pct * self.head_dim)
         return rd - rd % 2
 
     @property
@@ -560,7 +560,8 @@ def num_params(config: GPTConfig) -> int:
     C, L, V = cfg.n_embd, cfg.n_layer, cfg.vocab_size
     D, H, Hkv, F = cfg.head_dim, cfg.n_head, cfg.kv_heads, cfg.ffn_dim
     b = 1 if cfg.use_bias else 0
-    attn = C * (H + 2 * Hkv) * D + b * (H + 2 * Hkv) * D + C * C + b * C
+    ab = b if cfg.attn_bias is None else (1 if cfg.attn_bias else 0)
+    attn = C * (H + 2 * Hkv) * D + ab * (H + 2 * Hkv) * D + C * C + ab * C
     mlp = (3 if cfg.gated_mlp else 2) * C * F + b * (
         (2 if cfg.gated_mlp else 1) * F + C)
     norm_p = C * (2 if (cfg.norm == "layernorm" and cfg.use_bias) else 1)
@@ -570,6 +571,8 @@ def num_params(config: GPTConfig) -> int:
         total += cfg.n_positions * C
     if not cfg.tie_word_embeddings:
         total += C * V
+    if cfg.lm_head_bias:
+        total += V
     return total
 
 

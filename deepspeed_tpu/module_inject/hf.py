@@ -142,9 +142,10 @@ def bert_config_from_hf(hf_config, **overrides):
         intermediate_size=hf_config.intermediate_size,
         layer_norm_eps=hf_config.layer_norm_eps,
         # HF "gelu" is the exact erf form; "gelu_new"/"gelu_pytorch_tanh"
-        # are the tanh approximation
-        approximate_gelu=hf_config.hidden_act in (
-            "gelu_new", "gelu_pytorch_tanh", "gelu_fast"),
+        # are the tanh approximation; anything else is unsupported
+        approximate_gelu={
+            "gelu": False, "gelu_new": True, "gelu_pytorch_tanh": True,
+            "gelu_fast": True}[hf_config.hidden_act],
         dropout=0.0,
     )
     kw.update(overrides)
