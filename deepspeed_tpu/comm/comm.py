@@ -155,10 +155,15 @@ def init_distributed(
         process_id = _env_int("DS_TPU_PROC_ID")
     process_id = process_id if process_id is not None else _env_int("PROCESS_ID")
     if auto_mpi_discovery and process_id is None:
+        # scheduler-provided rank identity: OpenMPI, then Slurm (reference
+        # probes MPI/AzureML/SageMaker env the same way, comm/comm.py:640)
         ompi_rank = _env_int("OMPI_COMM_WORLD_RANK")
         if ompi_rank is not None:
             process_id = ompi_rank
             num_processes = num_processes or _env_int("OMPI_COMM_WORLD_SIZE")
+        elif _env_int("SLURM_PROCID") is not None:
+            process_id = _env_int("SLURM_PROCID")
+            num_processes = num_processes or _env_int("SLURM_NTASKS")
     multi_host = coordinator_address is not None or (
         num_processes is not None and num_processes > 1
     )

@@ -1,0 +1,145 @@
+"""Elastic training agent.
+
+Parity with reference ``elasticity/elastic_agent.py:23`` ``DSElasticAgent``:
+there, a torch-elastic agent supervises the worker group, re-rendezvouses on
+membership change, and restarts workers with updated WORLD_SIZE env. The
+TPU re-design supervises ONE process per host around slice preemption:
+
+* restart-on-failure loop with capped retries and backoff (the torch-elastic
+  ``monitor`` loop, elastic_agent.py:115);
+* on each (re)start the world is re-discovered via a host-count callback
+  (slice repair can resize), and the batch config is re-solved with
+  ``compute_elastic_config`` so the effective batch stays fixed across
+  world-size changes — the reference's core elasticity invariant;
+* workers are expected to resume from their latest checkpoint
+  (``load_checkpoint(tag='latest')``), which is the reference's recovery
+  path too — the agent only guarantees a consistent relaunch env.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+class ElasticAgentError(RuntimeError):
+    pass
+
+
+class DSElasticAgent:
+    """Supervise an elastic single-host-group training process.
+
+    Parameters
+    ----------
+    cmd:
+        argv of the training process (the agent prepends nothing; env
+        carries the elastic state).
+    ds_config:
+        DeepSpeed-style config dict with an ``elasticity`` block; used to
+        re-solve micro-batch/GAS per world size.
+    discover_world:
+        callback -> current world size (number of host processes). Defaults
+        to the DS_TPU_NUM_PROCS env or 1. In a real deployment this queries
+        the TPU slice/pod state after repair.
+    max_restarts / backoff_s:
+        restart budget for non-zero worker exits (preemption, slice loss).
+    """
+
+    def __init__(self, cmd: List[str], ds_config: Dict,
+                 discover_world: Optional[Callable[[], int]] = None,
+                 max_restarts: int = 3, backoff_s: float = 5.0,
+                 env: Optional[Dict[str, str]] = None):
+        self.cmd = list(cmd)
+        self.ds_config = ds_config
+        self.discover_world = discover_world or (
+            lambda: int(os.environ.get("DS_TPU_NUM_PROCS", "1")))
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.env = dict(env if env is not None else os.environ)
+        self.restart_count = 0
+        self._proc: Optional[subprocess.Popen] = None
+
+    # ------------------------------------------------------------------
+    def _worker_env(self, world: int) -> Dict[str, str]:
+        env = dict(self.env)
+        env["DS_TPU_NUM_PROCS"] = str(world)
+        env["DS_TPU_ELASTIC_RESTART"] = str(self.restart_count)
+        elastic = self.ds_config.get("elasticity")
+        if elastic and elastic.get("enabled"):
+            # re-solve the batch triad for the new world size so
+            # train_batch_size stays inside the elastic envelope
+            chips = world * int(env.get("DS_TPU_CHIPS_PER_PROC", "1"))
+            final_bs, _valid, micro = compute_elastic_config(
+                self.ds_config, world_size=chips, return_microbatch=True)
+            gas = max(1, final_bs // (micro * chips))
+            env["DS_TPU_ELASTIC_TRAIN_BATCH"] = str(final_bs)
+            env["DS_TPU_ELASTIC_MICRO_BATCH"] = str(micro)
+            env["DS_TPU_ELASTIC_GAS"] = str(gas)
+            logger.info(
+                f"elastic relaunch: world={world} batch={final_bs} "
+                f"micro={micro} gas={gas}")
+        return env
+
+    def _launch(self) -> subprocess.Popen:
+        world = self.discover_world()
+        if world < 1:
+            raise ElasticAgentError(f"discovered world size {world} < 1")
+        return subprocess.Popen(self.cmd, env=self._worker_env(world))
+
+    # ------------------------------------------------------------------
+    def run(self) -> int:
+        """Supervision loop: returns the final exit code (0 on success)."""
+        while True:
+            self._proc = self._launch()
+            try:
+                rc = self._proc.wait()
+            except KeyboardInterrupt:
+                self._proc.send_signal(signal.SIGTERM)
+                self._proc.wait()
+                return 1
+            if rc == 0:
+                return 0
+            if self.restart_count >= self.max_restarts:
+                logger.error(
+                    f"worker failed (rc={rc}) and restart budget "
+                    f"({self.max_restarts}) is exhausted")
+                return rc
+            self.restart_count += 1
+            logger.warning(
+                f"worker failed (rc={rc}); elastic restart "
+                f"{self.restart_count}/{self.max_restarts} in "
+                f"{self.backoff_s:.0f}s")
+            time.sleep(self.backoff_s)
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m deepspeed_tpu.elasticity.elastic_agent [--config
+    ds_config.json] -- cmd ...``"""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default=None)
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--backoff", type=float, default=5.0)
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        p.error("no worker command given")
+    cfg = {}
+    if args.config:
+        with open(args.config) as f:
+            cfg = json.load(f)
+    agent = DSElasticAgent(cmd, cfg, max_restarts=args.max_restarts,
+                           backoff_s=args.backoff)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,10 @@ def parse_args(argv=None):
     p.add_argument("--master_addr", default="",
                    help="coordinator address; default = first active host")
     p.add_argument("--ssh_port", type=int, default=None)
+    p.add_argument("--launcher", default="ssh",
+                   choices=["ssh", "pdsh", "openmpi", "slurm", "gcloud"],
+                   help="multinode backend (reference multinode_runner.py); "
+                        "'ssh' = builtin per-host ssh fan-out")
     p.add_argument("--force_multi", action="store_true")
     p.add_argument("--dry_run", action="store_true",
                    help="print the per-host commands without launching")
@@ -196,10 +200,27 @@ def main(argv=None) -> int:
     logger.info(f"launching on {len(hosts)} host(s); "
                 f"coordinator {coordinator}")
 
+    per_host = [build_host_command(args, idx, len(hosts), coordinator,
+                                   world_info)
+                for idx in range(len(hosts))]
+
+    if args.launcher != "ssh":
+        from deepspeed_tpu.launcher.multinode_runner import get_runner
+
+        runner = get_runner(args.launcher)
+        if not args.dry_run and not runner.backend_exists():
+            raise RuntimeError(
+                f"launcher backend {args.launcher!r} unavailable "
+                f"(tool not installed, or DS_TPU_NAME unset for gcloud)")
+        cmd = runner.get_cmd(hosts, per_host, args.hostfile)
+        if args.dry_run:
+            print(" ".join(shlex.quote(c) for c in cmd))
+            return 0
+        return subprocess.call(cmd)
+
     procs = []
     for idx, host in enumerate(hosts):
-        inner = build_host_command(args, idx, len(hosts), coordinator,
-                                   world_info)
+        inner = per_host[idx]
         cmd = (inner if host in ("localhost", "127.0.0.1")
                else build_ssh_command(host, inner, args.ssh_port))
         if args.dry_run:

@@ -129,6 +129,18 @@ def gpt2_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
 # ---------------------------------------------------------------------------
 # BERT (reference HFBertLayerPolicy, replace_policy.py:124)
 # ---------------------------------------------------------------------------
+_BERT_GELU = {"gelu": False, "gelu_new": True, "gelu_pytorch_tanh": True,
+              "gelu_fast": True}
+
+
+def _bert_gelu(act: str) -> bool:
+    if act not in _BERT_GELU:
+        raise ValueError(
+            f"unsupported BERT hidden_act {act!r}; the policy supports "
+            f"{sorted(_BERT_GELU)}")
+    return _BERT_GELU[act]
+
+
 def bert_config_from_hf(hf_config, **overrides):
     from deepspeed_tpu.models.bert import BertConfig
 
@@ -143,9 +155,7 @@ def bert_config_from_hf(hf_config, **overrides):
         layer_norm_eps=hf_config.layer_norm_eps,
         # HF "gelu" is the exact erf form; "gelu_new"/"gelu_pytorch_tanh"
         # are the tanh approximation; anything else is unsupported
-        approximate_gelu={
-            "gelu": False, "gelu_new": True, "gelu_pytorch_tanh": True,
-            "gelu_fast": True}[hf_config.hidden_act],
+        approximate_gelu=_bert_gelu(hf_config.hidden_act),
         dropout=0.0,
     )
     kw.update(overrides)

@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.comm.coalesced_collectives import (  # noqa: F401
+    all_gather_coalesced,
+    reduce_scatter_coalesced,
+)
