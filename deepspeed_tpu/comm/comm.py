@@ -161,7 +161,11 @@ def init_distributed(
         if ompi_rank is not None:
             process_id = ompi_rank
             num_processes = num_processes or _env_int("OMPI_COMM_WORLD_SIZE")
-        elif _env_int("SLURM_PROCID") is not None:
+        elif (_env_int("SLURM_PROCID") is not None
+              and coordinator_address is not None):
+            # gate on an explicit coordinator: SLURM_PROCID=0 exists inside
+            # any sbatch/salloc shell even for single-process runs, so the
+            # bare env must not trigger a multi-host rendezvous
             process_id = _env_int("SLURM_PROCID")
             num_processes = num_processes or _env_int("SLURM_NTASKS")
     multi_host = coordinator_address is not None or (

@@ -48,6 +48,16 @@ class MultiNodeRunner:
         return "".join(f"export {k}={shlex.quote(v)}; "
                        for k, v in sorted(self.exports.items()))
 
+    def _remote_prefix(self) -> str:
+        """cd to the launch cwd + propagate PYTHONPATH, matching the
+        builtin ssh backend (runner.build_ssh_command) so relative script
+        paths resolve identically under every launcher."""
+        prefix = f"cd {shlex.quote(os.getcwd())} && "
+        pythonpath = os.environ.get("PYTHONPATH", "")
+        if pythonpath:
+            prefix += f"export PYTHONPATH={shlex.quote(pythonpath)} && "
+        return prefix + self._export_prefix()
+
 
 def _strip_env_prefix(cmd: List[str]) -> Tuple[Dict[str, str], List[str]]:
     """Split runner.build_host_command's ``env K=V ... prog args`` prefix
@@ -83,7 +93,7 @@ class PDSHRunner(MultiNodeRunner):
             # arm matches the hostfile name as a word inside the host's
             # identity string (short + fqdn + IPs)
             cases.append(
-                f"*\" {host} \"*) {self._export_prefix()}{_shjoin(cmd)} ;;")
+                f"*\" {host} \"*) {self._remote_prefix()}{_shjoin(cmd)} ;;")
         ident = ('" $(hostname -s) $(hostname -f 2>/dev/null) '
                  '$(hostname -I 2>/dev/null) "')
         script = (f"case {ident} in {' '.join(cases)} "
@@ -159,7 +169,7 @@ class GcloudTPURunner(MultiNodeRunner):
         # from the TPU runtime metadata jax.distributed reads natively, so
         # the DS_TPU_* rendezvous envs are dropped entirely
         _env, payload = _strip_env_prefix(per_host_cmds[0])
-        remote = self._export_prefix() + _shjoin(payload)
+        remote = self._remote_prefix() + _shjoin(payload)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name,
                "--worker=all", f"--command={remote}"]
         if self.zone:

@@ -1,0 +1,191 @@
+"""CLIP text + vision encoders.
+
+Capability counterpart of the reference's CLIP path: ``HFCLIPLayerPolicy``
+(``module_inject/replace_policy.py:186``) injects fused kernels into HF CLIP
+encoder layers, and ``DSClipEncoder`` (``model_implementations/``) wraps the
+text tower for stable-diffusion serving.
+
+TPU re-design: both towers REUSE the GPT trunk's :class:`Block` — a CLIP
+encoder layer is the same pre-LN attention+MLP block with ``quick_gelu`` and
+(for vision) bidirectional attention — so every trunk feature (scan-over-
+layers, remat, flash attention, TP rules) applies unchanged. Only the
+embeddings, pooling, and projections are CLIP-specific.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer_lm import (
+    GPTConfig,
+    ScannedBlocks,
+    _norm,
+    gpt_tp_rules,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    intermediate_size: int = 2048
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"
+    projection_dim: int = 512
+    eos_token_id: int = 49407
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+
+    def trunk(self) -> GPTConfig:
+        return GPTConfig(
+            vocab_size=self.vocab_size,
+            n_positions=self.max_position_embeddings,
+            n_embd=self.hidden_size,
+            n_layer=self.num_hidden_layers,
+            n_head=self.num_attention_heads,
+            intermediate_size=self.intermediate_size,
+            layer_norm_epsilon=self.layer_norm_eps,
+            activation=self.hidden_act,
+            causal=True,  # CLIP text attends causally
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            scan_layers=self.scan_layers, dropout=0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPVisionConfig:
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"
+    projection_dim: int = 512
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def trunk(self) -> GPTConfig:
+        return GPTConfig(
+            vocab_size=1,  # unused by the trunk blocks
+            n_positions=self.num_patches + 1,
+            n_embd=self.hidden_size,
+            n_layer=self.num_hidden_layers,
+            n_head=self.num_attention_heads,
+            intermediate_size=self.intermediate_size,
+            layer_norm_epsilon=self.layer_norm_eps,
+            activation=self.hidden_act,
+            causal=False,  # vision attends bidirectionally
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            scan_layers=self.scan_layers, dropout=0.0)
+
+
+class CLIPTextModel(nn.Module):
+    """Text tower: returns (last_hidden_state, pooled, projected)."""
+
+    config: CLIPTextConfig
+
+    tp_rules = staticmethod(gpt_tp_rules)
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        cfg = self.config
+        trunk = cfg.trunk()
+        B, T = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="token_embedding")
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="position_embedding")
+        x = tok(input_ids) + pos(jnp.arange(T)[None, :])
+        x, _ = ScannedBlocks(trunk, name="h")(
+            x, deterministic=deterministic)
+        x = _norm(trunk, "ln_f")(x)
+        # EOS pooling. HF semantics: legacy configs (eos_token_id == 2, all
+        # original OpenAI checkpoints) pool at argmax(input_ids) — the
+        # highest token id is the real EOT 49407; newer configs pool at the
+        # first position equal to eos_token_id.
+        if cfg.eos_token_id == 2:
+            eos_pos = jnp.argmax(input_ids, axis=1)
+        else:
+            eos_pos = jnp.argmax(
+                (input_ids == cfg.eos_token_id).astype(jnp.int32), axis=1)
+        pooled = x[jnp.arange(B), eos_pos]
+        proj = nn.Dense(cfg.projection_dim, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        name="text_projection")(pooled)
+        return x, pooled, proj
+
+
+class CLIPVisionModel(nn.Module):
+    """Vision tower: returns (last_hidden_state, pooled, projected)."""
+
+    config: CLIPVisionConfig
+
+    tp_rules = staticmethod(gpt_tp_rules)
+
+    @nn.compact
+    def __call__(self, pixel_values, deterministic=True):
+        """pixel_values: [batch, H, W, channels] (NHWC)."""
+        cfg = self.config
+        trunk = cfg.trunk()
+        B = pixel_values.shape[0]
+        patches = nn.Conv(
+            cfg.hidden_size,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="patch_embedding")(pixel_values.astype(cfg.dtype))
+        patches = patches.reshape(B, -1, cfg.hidden_size)
+        cls = self.param("class_embedding", nn.initializers.normal(0.02),
+                         (cfg.hidden_size,), cfg.param_dtype)
+        cls = jnp.broadcast_to(cls.astype(cfg.dtype),
+                               (B, 1, cfg.hidden_size))
+        x = jnp.concatenate([cls, patches], axis=1)
+        pos = nn.Embed(cfg.num_patches + 1, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="position_embedding")
+        x = x + pos(jnp.arange(x.shape[1])[None, :])
+        x = _norm(trunk, "pre_layernorm")(x)
+        x, _ = ScannedBlocks(trunk, name="h")(x, deterministic=deterministic)
+        pooled = _norm(trunk, "post_layernorm")(x[:, 0])
+        proj = nn.Dense(cfg.projection_dim, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        name="visual_projection")(pooled)
+        return x, pooled, proj
+
+
+class CLIPModel(nn.Module):
+    """Two-tower CLIP: contrastive text/image embeddings + logits."""
+
+    text_config: CLIPTextConfig
+    vision_config: CLIPVisionConfig
+    logit_scale_init: float = 2.6592
+
+    @nn.compact
+    def __call__(self, input_ids, pixel_values, deterministic=True):
+        _, _, t = CLIPTextModel(self.text_config, name="text_model")(
+            input_ids, deterministic=deterministic)
+        _, _, v = CLIPVisionModel(self.vision_config, name="vision_model")(
+            pixel_values, deterministic=deterministic)
+        t = t / jnp.linalg.norm(t.astype(jnp.float32), axis=-1,
+                                keepdims=True)
+        v = v / jnp.linalg.norm(v.astype(jnp.float32), axis=-1,
+                                keepdims=True)
+        scale = jnp.exp(self.param(
+            "logit_scale",
+            nn.initializers.constant(self.logit_scale_init), ()))
+        logits_per_text = scale * t @ v.T
+        return logits_per_text, logits_per_text.T

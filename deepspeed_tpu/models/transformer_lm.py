@@ -41,6 +41,8 @@ class GPTConfig:
     intermediate_size: Optional[int] = None  # None -> mlp_ratio * n_embd
     norm: str = "layernorm"            # "layernorm" | "rmsnorm" (LLaMA)
     activation: str = "gelu_tanh"      # "gelu_tanh"|"gelu"|"relu"|"silu"
+                                       # |"quick_gelu" (CLIP)
+    causal: bool = True                # False = bidirectional (encoders)
     gated_mlp: bool = False            # SwiGLU: act(gate) * up (LLaMA)
     use_bias: bool = True              # biases on dense + norm layers
     attn_bias: Optional[bool] = None   # override for attention projections
@@ -82,7 +84,7 @@ class GPTConfig:
                 f"got {self.sequence_parallel!r}")
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown norm {self.norm!r}")
-        if self.activation not in ("gelu_tanh", "gelu", "relu", "silu"):
+        if self.activation not in _ACTIVATIONS:
             raise ValueError(f"unknown activation {self.activation!r}")
         if self.n_kv_head is not None and self.n_head % self.n_kv_head:
             raise ValueError(
@@ -142,6 +144,8 @@ _ACTIVATIONS = {
     "gelu": lambda x: nn.gelu(x, approximate=False),
     "relu": nn.relu,
     "silu": nn.silu,
+    # CLIP's x * sigmoid(1.702 x)
+    "quick_gelu": lambda x: x * nn.sigmoid(1.702 * x),
 }
 
 
@@ -176,6 +180,9 @@ class CausalSelfAttention(nn.Module):
                     else jnp.repeat(t, H // Hkv, axis=2))
 
         if decode:
+            if not cfg.causal:
+                raise NotImplementedError(
+                    "decode path requires a causal model")
             # KV-cache append + attend (the reference's softmax_context
             # kernel with its inference_context.h cache management,
             # csrc/transformer/inference/). Chunk-aware: prefill writes T
@@ -245,7 +252,7 @@ class CausalSelfAttention(nn.Module):
             if get_default_topology().size("sp") > 1:
                 attn_fn = {"ring": ring_attention,
                            "ulysses": ulysses_attention}[cfg.sequence_parallel]
-                y = attn_fn(q, k, v, causal=True)
+                y = attn_fn(q, k, v, causal=cfg.causal)
                 y = y.reshape(B, T, C)
                 y = nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
                              param_dtype=cfg.param_dtype, name="c_proj")(y)
@@ -259,12 +266,14 @@ class CausalSelfAttention(nn.Module):
         if use_flash:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            y = flash_attention(q, k, v, causal=True)
+            y = flash_attention(q, k, v, causal=cfg.causal)
         else:
             scale = 1.0 / np.sqrt(D)
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            causal = jnp.tril(jnp.ones((T, T), dtype=bool))
-            att = jnp.where(causal[None, None, :, :], att, jnp.finfo(att.dtype).min)
+            if cfg.causal:
+                tri = jnp.tril(jnp.ones((T, T), dtype=bool))
+                att = jnp.where(tri[None, None, :, :], att,
+                                jnp.finfo(att.dtype).min)
             if mask is not None:
                 att = jnp.where(mask[:, None, None, :], att, jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)

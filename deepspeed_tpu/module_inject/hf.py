@@ -577,6 +577,105 @@ def llama_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
 
 
 # ---------------------------------------------------------------------------
+# CLIP (reference HFCLIPLayerPolicy, replace_policy.py:186 + DSClipEncoder)
+# ---------------------------------------------------------------------------
+def clip_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
+    """``transformers.CLIPModel`` -> ``(CLIPModel, params)``: both towers
+    convert onto the shared GPT-trunk blocks (quick_gelu; causal text,
+    bidirectional vision)."""
+    from deepspeed_tpu.models.clip import (
+        CLIPModel,
+        CLIPTextConfig,
+        CLIPVisionConfig,
+    )
+
+    tc, vc = hf_model.config.text_config, hf_model.config.vision_config
+    text_cfg = CLIPTextConfig(
+        vocab_size=tc.vocab_size, hidden_size=tc.hidden_size,
+        num_hidden_layers=tc.num_hidden_layers,
+        num_attention_heads=tc.num_attention_heads,
+        intermediate_size=tc.intermediate_size,
+        max_position_embeddings=tc.max_position_embeddings,
+        layer_norm_eps=tc.layer_norm_eps, hidden_act=tc.hidden_act,
+        projection_dim=hf_model.config.projection_dim,
+        eos_token_id=tc.eos_token_id, dtype=dtype)
+    vision_cfg = CLIPVisionConfig(
+        image_size=vc.image_size, patch_size=vc.patch_size,
+        num_channels=vc.num_channels, hidden_size=vc.hidden_size,
+        num_hidden_layers=vc.num_hidden_layers,
+        num_attention_heads=vc.num_attention_heads,
+        intermediate_size=vc.intermediate_size,
+        layer_norm_eps=vc.layer_norm_eps, hidden_act=vc.hidden_act,
+        projection_dim=hf_model.config.projection_dim, dtype=dtype)
+
+    full_sd = {k: v for k, v in hf_model.state_dict().items()}
+
+    def ln(prefix):
+        return {"scale": _np(full_sd[f"{prefix}.weight"]),
+                "bias": _np(full_sd[f"{prefix}.bias"])}
+
+    def linear(prefix):
+        return {"kernel": _np(full_sd[f"{prefix}.weight"]).T,
+                "bias": _np(full_sd[f"{prefix}.bias"])}
+
+    def tower_layers(tower, n_layer):
+        def layer(i):
+            p = f"{tower}.encoder.layers.{i}"
+            q = linear(f"{p}.self_attn.q_proj")
+            k = linear(f"{p}.self_attn.k_proj")
+            v = linear(f"{p}.self_attn.v_proj")
+            return {
+                "ln_1": ln(f"{p}.layer_norm1"),
+                "ln_2": ln(f"{p}.layer_norm2"),
+                "attn": {
+                    "c_attn": {
+                        "kernel": np.concatenate(
+                            [q["kernel"], k["kernel"], v["kernel"]], axis=1),
+                        "bias": np.concatenate(
+                            [q["bias"], k["bias"], v["bias"]]),
+                    },
+                    "c_proj": linear(f"{p}.self_attn.out_proj"),
+                },
+                "mlp": {"c_fc": linear(f"{p}.mlp.fc1"),
+                        "c_proj": linear(f"{p}.mlp.fc2")},
+            }
+
+        return {"block": _stack([layer(i) for i in range(n_layer)])}
+
+    text_params = {
+        "token_embedding": {"embedding": _np(
+            full_sd["text_model.embeddings.token_embedding.weight"])},
+        "position_embedding": {"embedding": _np(
+            full_sd["text_model.embeddings.position_embedding.weight"])},
+        "h": tower_layers("text_model", text_cfg.num_hidden_layers),
+        "ln_f": ln("text_model.final_layer_norm"),
+        "text_projection": {
+            "kernel": _np(full_sd["text_projection.weight"]).T},
+    }
+    # note the reference-era HF key typo: "pre_layrnorm"
+    pre_ln_key = ("vision_model.pre_layrnorm"
+                  if "vision_model.pre_layrnorm.weight" in full_sd
+                  else "vision_model.pre_layernorm")
+    vision_params = {
+        "patch_embedding": {"kernel": _np(
+            full_sd["vision_model.embeddings.patch_embedding.weight"]
+        ).transpose(2, 3, 1, 0)},
+        "class_embedding": _np(
+            full_sd["vision_model.embeddings.class_embedding"]),
+        "position_embedding": {"embedding": _np(
+            full_sd["vision_model.embeddings.position_embedding.weight"])},
+        "pre_layernorm": ln(pre_ln_key),
+        "h": tower_layers("vision_model", vision_cfg.num_hidden_layers),
+        "post_layernorm": ln("vision_model.post_layernorm"),
+        "visual_projection": {
+            "kernel": _np(full_sd["visual_projection.weight"]).T},
+    }
+    params = {"text_model": text_params, "vision_model": vision_params,
+              "logit_scale": _np(full_sd["logit_scale"])}
+    return CLIPModel(text_cfg, vision_cfg), params
+
+
+# ---------------------------------------------------------------------------
 # dispatch (reference replace_policy.py generic_policies / policy match in
 # replace_module.py:277)
 # ---------------------------------------------------------------------------
@@ -592,7 +691,51 @@ _HF_CONVERTERS = {
     "OPTForCausalLM": opt_from_hf,
     "LlamaForCausalLM": llama_from_hf,
     "MistralForCausalLM": llama_from_hf,
+    "CLIPModel": clip_from_hf,
 }
+
+
+# ---------------------------------------------------------------------------
+# export: flax params -> HF GPT-2 state dict (the reverse policy; reference
+# save_mp_checkpoint_path writes HF-loadable shards from injected modules,
+# replace_module.py — here the engine's trained params convert back so
+# checkpoints round-trip into the HF ecosystem)
+# ---------------------------------------------------------------------------
+def gpt2_to_hf_state_dict(params: Dict[str, Any], n_layer: int,
+                          scan_layers: bool = True) -> Dict[str, np.ndarray]:
+    """GPT param tree (GPT-2 architecture knobs) -> HF ``GPT2LMHeadModel``
+    state dict (numpy; caller wraps in torch tensors if needed)."""
+    import jax
+
+    def _n(x):
+        return np.asarray(x, np.float32)
+
+    sd: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": _n(params["wte"]["embedding"]),
+        "transformer.wpe.weight": _n(params["wpe"]["embedding"]),
+        "transformer.ln_f.weight": _n(params["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _n(params["ln_f"]["bias"]),
+    }
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]  # tied
+
+    def layer_tree(i):
+        if scan_layers:
+            blk = params["h"]["block"]
+            return jax.tree.map(lambda x: x[i], blk)
+        return params[f"h_{i}"]
+
+    for i in range(n_layer):
+        lp = layer_tree(i)
+        p = f"transformer.h.{i}"
+        for ln in ("ln_1", "ln_2"):
+            sd[f"{p}.{ln}.weight"] = _n(lp[ln]["scale"])
+            sd[f"{p}.{ln}.bias"] = _n(lp[ln]["bias"])
+        for mod, names in (("attn", ("c_attn", "c_proj")),
+                           ("mlp", ("c_fc", "c_proj"))):
+            for nm in names:
+                sd[f"{p}.{mod}.{nm}.weight"] = _n(lp[mod][nm]["kernel"])
+                sd[f"{p}.{mod}.{nm}.bias"] = _n(lp[mod][nm]["bias"])
+    return sd
 
 
 def _converter_for(model):

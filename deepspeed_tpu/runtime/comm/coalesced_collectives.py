@@ -45,30 +45,31 @@ def reduce_scatter_coalesced(tensors: Sequence[jnp.ndarray], axis: str
     return lax.psum_scatter(flat, axis, tiled=True)
 
 
-def all_gather_coalesced(tensors: Sequence[jnp.ndarray], axis: str
-                         ) -> List[List[jnp.ndarray]]:
-    """Gather a list of tensors across ``axis`` in one collective
+def all_gather_coalesced(shards: Sequence[jnp.ndarray], axis: str
+                         ) -> List[jnp.ndarray]:
+    """Reassemble full tensors from per-rank shards in ONE collective
     (reference ZeRO-3 ``all_gather_coalesced``,
-    partition_parameters.py:806): pack -> one all_gather -> unpack.
+    partition_parameters.py:806): each rank holds an equal-size flat shard
+    of every tensor; pack -> one tiled all_gather -> reslice.
 
-    Returns ``out[rank][i]`` = rank's copy of ``tensors[i]`` — per-RANK
-    lists, mirroring the reference where each rank contributed a distinct
-    shard."""
+    ``shards[i]`` is this rank's flat shard; the result's ``out[i]`` is the
+    full flat tensor of size ``world * shards[i].size`` (rank-major, the
+    partitioning ZeRO-3 uses — the caller reshapes/unpads). Memory is 1x
+    the gathered size; the reslice compiles to static slices of the single
+    gathered buffer."""
     world = lax.axis_size(axis)
-    flat, meta = _flatten_pad(tensors, world)
-    gathered = lax.all_gather(flat, axis, tiled=True)  # [world * padded]
+    sizes = [int(s.size) for s in shards]
+    flat = jnp.concatenate([s.ravel() for s in shards])
     per = flat.size
-    out: List[List[jnp.ndarray]] = []
-    for r in range(world):
-        chunk = lax.dynamic_slice_in_dim(gathered, r * per, per)
-        offset = 0
-        rank_out = []
-        for numel, shape, dtype in meta:
-            rank_out.append(
-                lax.dynamic_slice_in_dim(chunk, offset, numel)
-                .reshape(shape).astype(dtype))
-            offset += numel
-        out.append(rank_out)
+    gathered = lax.all_gather(flat, axis, tiled=True)  # [world * per]
+    packs = gathered.reshape(world, per)
+    out: List[jnp.ndarray] = []
+    offset = 0
+    for n, s in zip(sizes, shards):
+        # rank-major reassembly: [world, n] -> [world * n]
+        out.append(packs[:, offset:offset + n].reshape(world * n)
+                   .astype(s.dtype))
+        offset += n
     return out
 
 

@@ -159,6 +159,99 @@ def test_llama_decode_parity():
     np.testing.assert_array_equal(out[0], hf_out[0, 6:])
 
 
+def test_clip_parity():
+    """Two-tower CLIP (text causal / vision bidirectional, quick_gelu)
+    matches the HF forward after conversion."""
+    from deepspeed_tpu.module_inject.hf import import_hf_model
+
+    cfg = transformers.CLIPConfig(
+        text_config_dict=dict(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=48,
+            max_position_embeddings=16, eos_token_id=63),
+        vision_config_dict=dict(
+            image_size=24, patch_size=8, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=48),
+        projection_dim=24)
+    torch.manual_seed(6)
+    hf = transformers.CLIPModel(cfg).eval()
+
+    rng = np.random.RandomState(9)
+    ids = rng.randint(0, 62, size=(2, 10))
+    ids[:, -1] = 63  # eos for pooling
+    pixels = rng.randn(3, 24, 24, 3).astype(np.float32)
+
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values=torch.from_numpy(
+                     pixels.transpose(0, 3, 1, 2)))
+    model, params = import_hf_model(hf, dtype=jnp.float32)
+    lt, li = model.apply({"params": params}, jnp.asarray(ids),
+                         jnp.asarray(pixels), deterministic=True)
+    np.testing.assert_allclose(np.asarray(lt), out.logits_per_text.numpy(),
+                               atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(li), out.logits_per_image.numpy(),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_clip_legacy_eos_pooling():
+    """eos_token_id=2 configs (all original OpenAI checkpoints) pool at
+    argmax(input_ids) — the HF legacy branch."""
+    from deepspeed_tpu.module_inject.hf import import_hf_model
+
+    cfg = transformers.CLIPConfig(
+        text_config_dict=dict(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=48,
+            max_position_embeddings=16, eos_token_id=2),
+        vision_config_dict=dict(
+            image_size=16, patch_size=8, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=48),
+        projection_dim=16)
+    torch.manual_seed(7)
+    hf = transformers.CLIPModel(cfg).eval()
+
+    rng = np.random.RandomState(10)
+    ids = rng.randint(0, 50, size=(2, 8))
+    ids[0, 5] = 63  # "EOT" = highest id, mid-sequence
+    ids[1, 2] = 63
+    pixels = rng.randn(2, 16, 16, 3).astype(np.float32)
+
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 pixel_values=torch.from_numpy(pixels.transpose(0, 3, 1, 2)))
+    model, params = import_hf_model(hf, dtype=jnp.float32)
+    lt, _ = model.apply({"params": params}, jnp.asarray(ids),
+                        jnp.asarray(pixels), deterministic=True)
+    np.testing.assert_allclose(np.asarray(lt), out.logits_per_text.numpy(),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_gpt2_export_roundtrip():
+    """flax -> HF state dict -> fresh HF model reproduces our logits."""
+    from deepspeed_tpu.module_inject.hf import (
+        gpt2_from_hf,
+        gpt2_to_hf_state_dict,
+    )
+
+    hf = _tiny_gpt2()
+    model, params = gpt2_from_hf(hf, dtype=jnp.float32)
+    sd = gpt2_to_hf_state_dict(params, model.config.n_layer)
+
+    fresh = transformers.GPT2LMHeadModel(hf.config)
+    fresh.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+    fresh.eval()
+
+    ids = np.random.RandomState(11).randint(0, 128, size=(2, 15))
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids),
+                                  deterministic=True))
+    with torch.no_grad():
+        theirs = fresh(torch.from_numpy(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+
 def test_gpt2_generate_matches_full_context():
     """Greedy decode over the KV cache == argmax over full re-forward."""
     import deepspeed_tpu

@@ -128,24 +128,27 @@ def test_reduce_scatter_coalesced_matches_psum():
     np.testing.assert_allclose(np.asarray(out), flat, rtol=1e-5)
 
 
-def test_all_gather_coalesced_roundtrip():
+def test_all_gather_coalesced_reassembles_shards():
+    """Each rank holds a flat shard of two 'parameters'; one collective
+    rebuilds both full tensors on every rank (ZeRO-3 gather semantics)."""
     mesh = _mesh()
     rng = np.random.RandomState(1)
-    tensors = [jnp.asarray(rng.randn(8, 4), jnp.float32),
-               jnp.asarray(rng.randn(8, 3, 3), jnp.float32)]
+    full_a = rng.randn(8 * 4).astype(np.float32)   # shard = 4 elems/rank
+    full_b = rng.randn(8 * 9).astype(np.float32)   # shard = 9 elems/rank
 
     from deepspeed_tpu.runtime.comm import all_gather_coalesced
 
     def body(a, b):
-        per_rank = all_gather_coalesced([a[0], b[0]], "dp")
-        # reconstruct rank 3's tensors on every rank
-        return per_rank[3][0], per_rank[3][1]
+        out = all_gather_coalesced([a.ravel(), b.ravel()], "dp")
+        return out[0], out[1]
 
     got_a, got_b = jax.shard_map(
-        body, mesh=mesh, in_specs=(P("dp"), P("dp")),
-        out_specs=(P(), P()), check_vma=False)(*tensors)
-    np.testing.assert_allclose(np.asarray(got_a), np.asarray(tensors[0][3]))
-    np.testing.assert_allclose(np.asarray(got_b), np.asarray(tensors[1][3]))
+        body, mesh=mesh,
+        in_specs=(P("dp"), P("dp")), out_specs=(P(), P()),
+        check_vma=False)(jnp.asarray(full_a.reshape(8, 4)),
+                         jnp.asarray(full_b.reshape(8, 9)))
+    np.testing.assert_allclose(np.asarray(got_a), full_a)
+    np.testing.assert_allclose(np.asarray(got_b), full_b)
 
 
 def test_shard_layout_spans():
