@@ -162,10 +162,12 @@ def init_distributed(
             process_id = ompi_rank
             num_processes = num_processes or _env_int("OMPI_COMM_WORLD_SIZE")
         elif (_env_int("SLURM_PROCID") is not None
-              and coordinator_address is not None):
-            # gate on an explicit coordinator: SLURM_PROCID=0 exists inside
-            # any sbatch/salloc shell even for single-process runs, so the
-            # bare env must not trigger a multi-host rendezvous
+              and (_env_int("SLURM_NTASKS") or 1) > 1):
+            # gate on ntasks > 1: SLURM_PROCID=0 exists inside any
+            # sbatch/salloc shell even for single-process runs, and must
+            # not trigger a multi-host rendezvous; real srun multi-task
+            # jobs carry SLURM_NTASKS > 1 (jax's Slurm cluster detection
+            # supplies the coordinator when none is given explicitly)
             process_id = _env_int("SLURM_PROCID")
             num_processes = num_processes or _env_int("SLURM_NTASKS")
     multi_host = coordinator_address is not None or (

@@ -168,8 +168,11 @@ class GcloudTPURunner(MultiNodeRunner):
         # every worker runs the same payload; per-worker identity comes
         # from the TPU runtime metadata jax.distributed reads natively, so
         # the DS_TPU_* rendezvous envs are dropped entirely
+        # no cd-to-launch-cwd here: TPU VMs share no filesystem with the
+        # launch workstation — code is staged in the VM home and the
+        # command runs from there
         _env, payload = _strip_env_prefix(per_host_cmds[0])
-        remote = self._remote_prefix() + _shjoin(payload)
+        remote = self._export_prefix() + _shjoin(payload)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name,
                "--worker=all", f"--command={remote}"]
         if self.zone:

@@ -174,6 +174,9 @@ class CLIPModel(nn.Module):
     vision_config: CLIPVisionConfig
     logit_scale_init: float = 2.6592
 
+    # nested tower paths keep the trunk suffixes, so the GPT TP rules apply
+    tp_rules = staticmethod(gpt_tp_rules)
+
     @nn.compact
     def __call__(self, input_ids, pixel_values, deterministic=True):
         _, _, t = CLIPTextModel(self.text_config, name="text_model")(

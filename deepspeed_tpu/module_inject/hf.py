@@ -589,6 +589,8 @@ def clip_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
         CLIPVisionConfig,
     )
 
+    import dataclasses as _dc
+
     tc, vc = hf_model.config.text_config, hf_model.config.vision_config
     text_cfg = CLIPTextConfig(
         vocab_size=tc.vocab_size, hidden_size=tc.hidden_size,
@@ -607,6 +609,23 @@ def clip_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
         intermediate_size=vc.intermediate_size,
         layer_norm_eps=vc.layer_norm_eps, hidden_act=vc.hidden_act,
         projection_dim=hf_model.config.projection_dim, dtype=dtype)
+    # overrides apply to whichever tower config defines the field (dtype,
+    # param_dtype, scan_layers, ... — like the sibling converters)
+    for key, val in config_overrides.items():
+        applied = False
+        for cfg in ("text", "vision"):
+            c = text_cfg if cfg == "text" else vision_cfg
+            if any(f.name == key for f in _dc.fields(c)):
+                if cfg == "text":
+                    text_cfg = _dc.replace(text_cfg, **{key: val})
+                else:
+                    vision_cfg = _dc.replace(vision_cfg, **{key: val})
+                applied = True
+        if not applied:
+            raise ValueError(f"unknown CLIP config override {key!r}")
+    if text_cfg.scan_layers is not True or vision_cfg.scan_layers is not True:
+        raise NotImplementedError(
+            "clip_from_hf packs layers in the scan layout only")
 
     full_sd = {k: v for k, v in hf_model.state_dict().items()}
 

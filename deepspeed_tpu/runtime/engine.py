@@ -88,6 +88,12 @@ def initialize(
     SURVEY.md §7 hard part (b)). ``optimizer`` may be an optax
     GradientTransformation to override the config block; ``lr_scheduler`` an
     LRScheduler or trace-safe ``step -> lr`` callable.
+
+    ``model_parameters`` (reference: the params list handed to the
+    optimizer) here takes a parameter PYTREE to fine-tune from — e.g. an HF
+    checkpoint converted by module_inject.hf.import_hf_model — which the
+    engine materializes onto the mesh with its ZeRO/TP shardings instead of
+    randomly initializing.
     """
     from deepspeed_tpu import comm
 
@@ -105,6 +111,10 @@ def initialize(
     from deepspeed_tpu.runtime.pipe import PipelineModule  # lazy, avoids cycle
 
     if isinstance(model, PipelineModule):
+        if model_parameters is not None:
+            raise NotImplementedError(
+                "model_parameters (initial weights) is not supported for "
+                "PipelineModule yet; load a checkpoint instead")
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 
         engine = PipelineEngine(
@@ -119,6 +129,7 @@ def initialize(
             optimizer=optimizer,
             lr_scheduler=lr_scheduler,
             sample_batch=sample_batch,
+            initial_params=model_parameters,
             seed=seed,
         )
 
@@ -158,9 +169,11 @@ class DeepSpeedEngine:
         optimizer=None,
         lr_scheduler=None,
         sample_batch=None,
+        initial_params=None,
         seed: int = 0,
     ):
         self.module = model
+        self._initial_params = initial_params
         if not isinstance(config, DeepSpeedConfig):
             # resolve triad after topology is known
             config = DeepSpeedConfig(config)
@@ -330,6 +343,32 @@ class DeepSpeedEngine:
         except Exception:
             return None
 
+    def _place_initial_params(self, param_shapes):
+        """Materialize user-provided initial params (fine-tune entry, e.g.
+        an imported HF checkpoint) onto the mesh with the engine's ZeRO/TP
+        shardings — the pretrained-weights counterpart of zero.Init's
+        shard-at-construction (reference partition_parameters.py:537)."""
+        expect = jax.tree.structure(param_shapes)
+        got = jax.tree.structure(self._initial_params)
+        if expect != got:
+            raise ValueError(
+                "model_parameters tree does not match the model's params "
+                f"structure:\n  expected {expect}\n  got      {got}")
+
+        def place(leaf, shape_dtype, sharding):
+            # stay on HOST until the sharded device_put: each device then
+            # receives only its shard (an eager jnp.asarray would
+            # materialize the full parameter on one chip first)
+            arr = np.asarray(leaf, dtype=shape_dtype.dtype)
+            if arr.shape != shape_dtype.shape:
+                raise ValueError(
+                    f"model_parameters leaf shape {arr.shape} != model "
+                    f"shape {shape_dtype.shape}")
+            return jax.device_put(arr, sharding)
+
+        return jax.tree.map(place, self._initial_params, param_shapes,
+                            self._param_shardings)
+
     # ------------------------------------------------------------------
     # lazy state init (zero.Init equivalent)
     # ------------------------------------------------------------------
@@ -347,7 +386,12 @@ class DeepSpeedEngine:
         self._compute_dtype = jax.tree.leaves(param_shapes)[0].dtype
 
         t0 = time.time()
-        self._params = jax.jit(init_fn, out_shardings=self._param_shardings)(init_rngs)
+        if self._initial_params is not None:
+            self._params = self._place_initial_params(param_shapes)
+            self._initial_params = None  # free the host copy
+        else:
+            self._params = jax.jit(
+                init_fn, out_shardings=self._param_shardings)(init_rngs)
         if self._offload_device in ("cpu", "nvme"):
             # ZeRO-Offload: fp32 masters + moments on host (zero/offload.py)
             # — no device optimizer state is ever allocated
