@@ -162,14 +162,17 @@ def init_distributed(
             process_id = ompi_rank
             num_processes = num_processes or _env_int("OMPI_COMM_WORLD_SIZE")
         elif (_env_int("SLURM_PROCID") is not None
-              and (_env_int("SLURM_NTASKS") or 1) > 1):
-            # gate on ntasks > 1: SLURM_PROCID=0 exists inside any
-            # sbatch/salloc shell even for single-process runs, and must
-            # not trigger a multi-host rendezvous; real srun multi-task
-            # jobs carry SLURM_NTASKS > 1 (jax's Slurm cluster detection
-            # supplies the coordinator when none is given explicitly)
+              and os.environ.get("SLURM_STEP_ID") is not None
+              and (_env_int("SLURM_STEP_NUM_TASKS")
+                   or _env_int("SLURM_NTASKS") or 1) > 1):
+            # only inside an actual srun step (SLURM_STEP_ID) with more
+            # than one task: a bare `python train.py` in an sbatch/salloc
+            # shell carries SLURM_PROCID=0 + SLURM_NTASKS but must stay a
+            # single-host no-op, not hang in rendezvous (jax's Slurm
+            # cluster detection supplies the coordinator when none given)
             process_id = _env_int("SLURM_PROCID")
-            num_processes = num_processes or _env_int("SLURM_NTASKS")
+            num_processes = num_processes or _env_int(
+                "SLURM_STEP_NUM_TASKS") or _env_int("SLURM_NTASKS")
     multi_host = coordinator_address is not None or (
         num_processes is not None and num_processes > 1
     )

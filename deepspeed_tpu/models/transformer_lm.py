@@ -76,6 +76,7 @@ class GPTConfig:
     moe_aux_loss_coef: float = 0.01
     moe_noisy_gate_policy: Optional[str] = None
     moe_use_rts: bool = True
+    moe_gated_experts: bool = False  # SwiGLU experts (Mixtral-style)
 
     def __post_init__(self):
         if self.sequence_parallel not in ("none", "ring", "ulysses"):
@@ -334,7 +335,7 @@ class Block(nn.Module):
 
             y, l_aux, _ = MoE(
                 d_model=cfg.n_embd,
-                d_hidden=cfg.mlp_ratio * cfg.n_embd,
+                d_hidden=cfg.ffn_dim,
                 num_experts=cfg.moe_num_experts,
                 k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
@@ -343,6 +344,7 @@ class Block(nn.Module):
                 noisy_gate_policy=cfg.moe_noisy_gate_policy,
                 drop_tokens=cfg.moe_drop_tokens,
                 use_rts=cfg.moe_use_rts,
+                gated_experts=cfg.moe_gated_experts,
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 name="mlp",

@@ -577,6 +577,109 @@ def llama_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
 
 
 # ---------------------------------------------------------------------------
+# Mixtral (beyond the reference snapshot: its MoE layer surface —
+# deepspeed/moe/layer.py — extended to the HF sparse-MoE generation)
+# ---------------------------------------------------------------------------
+def mixtral_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
+    """``transformers.MixtralForCausalLM`` -> ``(GPT, params)``: the LLaMA
+    trunk (RMSNorm/GQA/rotary) with top-2 gated-SwiGLU experts mapped onto
+    the expert-parallel MoE layer (moe/layer.py).
+
+    Routing parity: Mixtral renormalizes the softmax over the top-2 logits,
+    which equals our full-softmax-then-top-2-renormalize gating; eval
+    capacity is set so no token drops (Mixtral has no capacity limit).
+    """
+    from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+
+    hc = hf_model.config
+    if getattr(hc, "rope_scaling", None):
+        raise ValueError("rope_scaling is not supported by this policy")
+    if getattr(hc, "sliding_window", None):
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            "sliding_window=%s ignored: converted model attends over the "
+            "full context (exact only for sequences within the window)",
+            hc.sliding_window)
+    E = hc.num_local_experts
+    kw = dict(
+        vocab_size=hc.vocab_size,
+        n_positions=hc.max_position_embeddings,
+        n_embd=hc.hidden_size,
+        n_layer=hc.num_hidden_layers,
+        n_head=hc.num_attention_heads,
+        n_kv_head=hc.num_key_value_heads,
+        intermediate_size=hc.intermediate_size,
+        layer_norm_epsilon=hc.rms_norm_eps,
+        norm="rmsnorm",
+        activation={"silu": "silu"}[hc.hidden_act],
+        use_bias=False,
+        rotary=True,
+        rope_theta=float(hc.rope_theta),
+        learned_positions=False,
+        tie_word_embeddings=bool(hc.tie_word_embeddings),
+        moe_num_experts=E,
+        moe_top_k=hc.num_experts_per_tok,
+        moe_gated_experts=True,
+        moe_aux_loss_coef=float(getattr(hc, "router_aux_loss_coef", 0.001)),
+        # eval capacity covers every token landing on one expert, so
+        # serving never drops (Mixtral has no capacity limit) and logits
+        # match HF exactly; TRAINING keeps a bounded capacity — exact
+        # no-drop there would make dispatch tensors O(E*T^2)
+        moe_capacity_factor=2.0,
+        moe_eval_capacity_factor=float(E),
+        dropout=0.0, dtype=dtype,
+    )
+    kw.update(config_overrides)
+    cfg = GPTConfig(**kw)
+
+    full_sd = hf_model.state_dict()
+    sd = {k.removeprefix("model."): v for k, v in full_sd.items()}
+
+    def rms(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"])}
+
+    def linear(prefix):
+        return {"kernel": _np(sd[f"{prefix}.weight"]).T}
+
+    def layer(i):
+        p = f"layers.{i}"
+        qw = linear(f"{p}.self_attn.q_proj")["kernel"]
+        kw_ = linear(f"{p}.self_attn.k_proj")["kernel"]
+        vw = linear(f"{p}.self_attn.v_proj")["kernel"]
+        moe = f"{p}.block_sparse_moe"
+        # experts.{e}.w1 = gate, w3 = up, w2 = down (all [out, in])
+        wg = np.stack([_np(sd[f"{moe}.experts.{e}.w1.weight"]).T
+                       for e in range(E)])
+        wi = np.stack([_np(sd[f"{moe}.experts.{e}.w3.weight"]).T
+                       for e in range(E)])
+        wo = np.stack([_np(sd[f"{moe}.experts.{e}.w2.weight"]).T
+                       for e in range(E)])
+        return {
+            "ln_1": rms(f"{p}.input_layernorm"),
+            "ln_2": rms(f"{p}.post_attention_layernorm"),
+            "attn": {
+                "c_attn": {"kernel": np.concatenate([qw, kw_, vw], axis=1)},
+                "c_proj": linear(f"{p}.self_attn.o_proj"),
+            },
+            "mlp": {
+                "gate": {"kernel": _np(sd[f"{moe}.gate.weight"]).T},
+                "experts": {"wi": wi, "wg": wg, "wo": wo},
+            },
+        }
+
+    params = {
+        "wte": {"embedding": _np(sd["embed_tokens.weight"])},
+        "ln_f": rms("norm"),
+    }
+    _pack_gpt_layers(params, [layer(i) for i in range(cfg.n_layer)],
+                     cfg.scan_layers)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _np(full_sd["lm_head.weight"]).T
+    return GPT(cfg), params
+
+
+# ---------------------------------------------------------------------------
 # CLIP (reference HFCLIPLayerPolicy, replace_policy.py:186 + DSClipEncoder)
 # ---------------------------------------------------------------------------
 def clip_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
@@ -710,6 +813,7 @@ _HF_CONVERTERS = {
     "OPTForCausalLM": opt_from_hf,
     "LlamaForCausalLM": llama_from_hf,
     "MistralForCausalLM": llama_from_hf,
+    "MixtralForCausalLM": mixtral_from_hf,
     "CLIPModel": clip_from_hf,
 }
 

@@ -14,11 +14,15 @@ import jax.numpy as jnp
 
 
 class StackedExperts(nn.Module):
-    """[E, C, M] -> [E, C, M] two-layer gelu FFN, vectorized over experts.
+    """[E, C, M] -> [E, C, M] two-layer FFN, vectorized over experts.
 
     Param shapes carry the expert axis first (``wi: [E, M, H]``,
     ``wo: [E, H, M]``) so expert-parallel sharding rules can address it
     (see moe/layer.py moe_sharding_rules).
+
+    ``gated=True`` makes each expert a SwiGLU FFN (Mixtral-style:
+    ``wo @ (act(wg x) * (wi x))``, biasless), with a ``wg`` gate tensor
+    alongside ``wi`` — same expert-parallel layout.
     """
 
     num_experts: int
@@ -27,29 +31,32 @@ class StackedExperts(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     activation: Callable = nn.gelu
+    gated: bool = False
+    use_bias: bool = True
 
     @nn.compact
     def __call__(self, x):
-        wi = self.param(
-            "wi", nn.initializers.lecun_normal(),
-            (self.num_experts, self.d_model, self.d_hidden), self.param_dtype,
-        )
-        bi = self.param(
-            "bi", nn.initializers.zeros,
-            (self.num_experts, self.d_hidden), self.param_dtype,
-        )
-        wo = self.param(
-            "wo", nn.initializers.lecun_normal(),
-            (self.num_experts, self.d_hidden, self.d_model), self.param_dtype,
-        )
-        bo = self.param(
-            "bo", nn.initializers.zeros,
-            (self.num_experts, self.d_model), self.param_dtype,
-        )
+        E, M, H = self.num_experts, self.d_model, self.d_hidden
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (E, M, H), self.param_dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (E, H, M), self.param_dtype)
         x = x.astype(self.dtype)
         h = jnp.einsum("ecm,emh->ech", x, wi.astype(self.dtype))
-        h = h + bi[:, None, :].astype(self.dtype)
-        h = self.activation(h)
+        if self.use_bias:
+            bi = self.param("bi", nn.initializers.zeros, (E, H),
+                            self.param_dtype)
+            h = h + bi[:, None, :].astype(self.dtype)
+        if self.gated:
+            wg = self.param("wg", nn.initializers.lecun_normal(),
+                            (E, M, H), self.param_dtype)
+            g = jnp.einsum("ecm,emh->ech", x, wg.astype(self.dtype))
+            h = self.activation(g) * h
+        else:
+            h = self.activation(h)
         y = jnp.einsum("ech,ehm->ecm", h, wo.astype(self.dtype))
-        y = y + bo[:, None, :].astype(self.dtype)
+        if self.use_bias:
+            bo = self.param("bo", nn.initializers.zeros, (E, M),
+                            self.param_dtype)
+            y = y + bo[:, None, :].astype(self.dtype)
         return y

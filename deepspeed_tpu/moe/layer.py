@@ -61,6 +61,8 @@ class MoE(nn.Module):
     use_rts: bool = True
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    gated_experts: bool = False      # SwiGLU experts (Mixtral-style)
+    expert_activation: Any = None    # defaults: gelu, or silu when gated
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
@@ -92,12 +94,17 @@ class MoE(nn.Module):
 
         dispatched = dispatch_tokens(gout.dispatch_mask, tokens)  # [E,C,M]
         dispatched = _ep_constraint(dispatched, ("ep", None, None))
+        act = self.expert_activation or (
+            nn.silu if self.gated_experts else nn.gelu)
         expert_out = StackedExperts(
             num_experts=self.num_experts,
             d_model=self.d_model,
             d_hidden=self.d_hidden,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
+            activation=act,
+            gated=self.gated_experts,
+            use_bias=not self.gated_experts,
             name="experts",
         )(dispatched)
         expert_out = _ep_constraint(expert_out, ("ep", None, None))
@@ -122,7 +129,7 @@ def moe_param_spec(path: str, shape) -> Optional[PartitionSpec]:
 
     if "experts/" not in path:
         return None
-    if path.endswith("experts/wi"):
+    if path.endswith(("experts/wi", "experts/wg")):
         return spec(**{str(ndim - 3): "ep", str(ndim - 1): "tp"})
     if path.endswith("experts/wo"):
         return spec(**{str(ndim - 3): "ep", str(ndim - 2): "tp"})

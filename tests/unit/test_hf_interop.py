@@ -159,6 +159,30 @@ def test_llama_decode_parity():
     np.testing.assert_array_equal(out[0], hf_out[0, 6:])
 
 
+def test_mixtral_logit_parity():
+    """Sparse-MoE (top-2 gated-SwiGLU experts on the LLaMA trunk) matches
+    the HF Mixtral forward after policy conversion."""
+    from deepspeed_tpu.module_inject.hf import import_hf_model
+
+    cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=48,
+        max_position_embeddings=64, num_local_experts=4,
+        num_experts_per_tok=2, tie_word_embeddings=False,
+        attention_dropout=0.0)
+    torch.manual_seed(8)
+    hf = transformers.MixtralForCausalLM(cfg).eval()
+
+    ids = np.random.RandomState(13).randint(0, 128, size=(2, 11))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+
+    model, params = import_hf_model(hf, dtype=jnp.float32)
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(ids),
+                                 deterministic=True))
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
 def test_clip_parity():
     """Two-tower CLIP (text causal / vision bidirectional, quick_gelu)
     matches the HF forward after conversion."""

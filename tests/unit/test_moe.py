@@ -113,6 +113,26 @@ class TestMoELayer:
             np.asarray(y), np.asarray(dense * np.asarray(
                 _top1_probs(layer, p, x))[..., None]), atol=1e-4)
 
+    def test_gated_experts_match_dense_swiglu(self):
+        """gated_experts=True: each expert is a biasless SwiGLU FFN
+        (Mixtral-style); with identical experts the MoE output equals the
+        dense SwiGLU reference scaled by the gate prob."""
+        layer = self._layer(E=4, gated_experts=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+        params = layer.init(jax.random.PRNGKey(1), x)
+        ex = params["params"]["experts"]
+        assert set(ex) == {"wi", "wg", "wo"}  # biasless, with a gate tensor
+        for k in ex:
+            ex[k] = jnp.broadcast_to(ex[k][:1], ex[k].shape)
+        y, _, _ = layer.apply(params, x)
+
+        h = jnp.einsum("btm,mh->bth", x, ex["wi"][0])
+        g = jnp.einsum("btm,mh->bth", x, ex["wg"][0])
+        dense = jnp.einsum("bth,hm->btm", jax.nn.silu(g) * h, ex["wo"][0])
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(dense * np.asarray(
+                _top1_probs(layer, params, x))[..., None]), atol=1e-4)
+
     def test_grads_flow_to_experts_and_gate(self):
         layer = self._layer()
         x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))

@@ -206,7 +206,8 @@ def test_elastic_agent_budget_exhausted(tmp_path):
 
 
 def test_init_distributed_slurm_discovery(monkeypatch):
-    """Under srun, rank identity comes from SLURM_PROCID/SLURM_NTASKS."""
+    """Inside an srun step, rank identity comes from SLURM_PROCID; a bare
+    process in an sbatch/salloc shell (no step) must stay a no-op."""
     from deepspeed_tpu.comm import comm
 
     captured = {}
@@ -215,6 +216,8 @@ def test_init_distributed_slurm_discovery(monkeypatch):
     monkeypatch.setenv("DS_TPU_COORDINATOR", "head:29500")
     monkeypatch.setenv("SLURM_PROCID", "2")
     monkeypatch.setenv("SLURM_NTASKS", "4")
+    monkeypatch.setenv("SLURM_STEP_ID", "0")
+    monkeypatch.setenv("SLURM_STEP_NUM_TASKS", "4")
     monkeypatch.delenv("DS_TPU_PROC_ID", raising=False)
     monkeypatch.delenv("DS_TPU_NUM_PROCS", raising=False)
     monkeypatch.setattr(comm, "_initialized", False)
@@ -222,6 +225,16 @@ def test_init_distributed_slurm_discovery(monkeypatch):
     assert captured["process_id"] == 2
     assert captured["num_processes"] == 4
     assert captured["coordinator_address"] == "head:29500"
+    monkeypatch.setattr(comm, "_initialized", False)
+
+    # sbatch shell: SLURM_PROCID/NTASKS present but no srun step -> rank
+    # identity must NOT be inferred (no rendezvous hang)
+    captured.clear()
+    monkeypatch.delenv("DS_TPU_COORDINATOR")
+    monkeypatch.delenv("SLURM_STEP_ID")
+    monkeypatch.delenv("SLURM_STEP_NUM_TASKS")
+    comm.init_distributed()
+    assert captured == {}
     monkeypatch.setattr(comm, "_initialized", False)
 
 
