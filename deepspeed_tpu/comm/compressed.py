@@ -1,0 +1,100 @@
+"""Quantized (compressed) collectives.
+
+Counterpart of the reference's compressed-communication backends
+(``runtime/comm/nccl.py:51`` compressed_allreduce — error-compensated 1-bit
+over NCCL; cupy bit-packing) re-designed for XLA/ICI in the EQuARX style
+(see PAPERS.md): both all-reduce phases move int8 payloads with per-block
+scales instead of fp32, cutting collective bytes ~4x. The 1-bit optimizer
+variants live in ``runtime/fp16/onebit``; this is the generic tensor path.
+
+Quantization is the shared symmetric per-group int8 from
+``ops/quantizer.py`` (one implementation for MoQ, serving, and the wire).
+
+Scheme (inside shard_map over a named axis, W ranks):
+
+1. quantize the local tensor blockwise (int8 symmetric, per-block scale)
+2. reduce-scatter: each rank receives every rank's int8 copy of ITS shard
+   (``all_to_all`` on the quantized payload), dequantizes, and sums in f32
+3. re-quantize the reduced shard and ``all_gather`` it; dequantize
+
+Two rounds of quantization error; per-block scaling keeps relative error
+~1/127 per round. With ``return_error=True`` the caller gets the local
+(worker) residual for 1-bit-Adam-style error feedback on the next step.
+"""
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.ops.quantizer import dequantize, quantize
+
+
+def _quantize_blocks(flat: jnp.ndarray, block: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    q, scale, _ = quantize(flat, num_bits=8,
+                           num_groups=flat.size // block, symmetric=True)
+    return q, scale
+
+
+def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
+                         return_error: bool = False
+                         ) -> Union[jnp.ndarray,
+                                    Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Sum-all-reduce with int8 wire format (use inside shard_map/jit).
+
+    Returns the reduced tensor in ``x``'s shape/dtype (expect ~1e-2
+    relative error), plus — with ``return_error=True`` — the local phase-1
+    quantization residual ``x - dequant(quant(x))`` to carry as error
+    feedback into the next step's tensor (the 1-bit Adam pattern,
+    runtime/fp16/onebit/adam.py).
+    """
+    w = lax.axis_size(axis)
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).ravel()
+    n = flat.size
+    pad = (-n) % (w * block)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    per = flat.size // w  # this rank's shard length, a block multiple
+
+    # phase 1: quantize full tensor, all_to_all so rank r holds every
+    # rank's int8 copy of shard r
+    q, s = _quantize_blocks(flat, block)
+    q_recv = lax.all_to_all(q.reshape(w, per), axis,
+                            split_axis=0, concat_axis=0, tiled=False)
+    s_recv = lax.all_to_all(s.reshape(w, per // block), axis,
+                            split_axis=0, concat_axis=0, tiled=False)
+    # q_recv: [W, per] — W ranks' int8 copies of MY shard; dequant + sum
+    contribs = (q_recv.reshape(w, per // block, block).astype(jnp.float32)
+                * s_recv[..., None])
+    reduced = jnp.sum(contribs, axis=0).reshape(per)
+
+    # phase 2: re-quantize the reduced shard, all_gather, dequantize
+    q2, s2 = _quantize_blocks(reduced, block)
+    q_all = lax.all_gather(q2, axis, tiled=True)      # [W * per]
+    s_all = lax.all_gather(s2, axis, tiled=True)      # [W * per/block]
+    out = dequantize(q_all, s_all)
+    if pad:
+        out = out[:n]
+    out = out.reshape(shape).astype(dtype)
+    if not return_error:
+        return out
+    err = flat - dequantize(q, s)
+    if pad:
+        err = err[:n]
+    return out, err.reshape(shape).astype(dtype)
+
+
+def quantization_error(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
+    """Residual ``x - dequant(quant(x))`` for error-feedback loops."""
+    flat = x.astype(jnp.float32).ravel()
+    n = flat.size
+    pad = (-n) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    q, s = _quantize_blocks(flat, block)
+    err = flat - dequantize(q, s)
+    if pad:
+        err = err[:n]
+    return err.reshape(x.shape).astype(x.dtype)

@@ -622,12 +622,14 @@ def mixtral_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
         moe_top_k=hc.num_experts_per_tok,
         moe_gated_experts=True,
         moe_aux_loss_coef=float(getattr(hc, "router_aux_loss_coef", 0.001)),
-        # eval capacity covers every token landing on one expert, so
-        # serving never drops (Mixtral has no capacity limit) and logits
-        # match HF exactly; TRAINING keeps a bounded capacity — exact
-        # no-drop there would make dispatch tensors O(E*T^2)
+        # Mixtral itself has no capacity limit; capacity = tokens would be
+        # exact but makes dispatch tensors O(E*T^2), so both train and
+        # eval keep bounded factors (4x headroom over perfectly balanced
+        # top-2 routing at eval — drops only under >4x imbalance; raise
+        # moe_eval_capacity_factor toward num_local_experts for exactness
+        # on short prompts)
         moe_capacity_factor=2.0,
-        moe_eval_capacity_factor=float(E),
+        moe_eval_capacity_factor=4.0,
         dropout=0.0, dtype=dtype,
     )
     kw.update(config_overrides)
