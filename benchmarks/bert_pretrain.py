@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""BERT pretraining throughput on one chip.
+
+Direct counterpart of the reference's headline number (BASELINE.md: 64
+TFLOPS / 272 samples-per-sec per V100 for BERT-Large MLM at seq 128,
+reference docs/_posts/2020-05-28-fastest-bert-training.md:36): same model,
+same sequence length, measured the same way (achieved model TFLOPS +
+samples/sec). ``run()`` is shared with the repo-root ``bench.py``.
+
+  python benchmarks/bert_pretrain.py --model bert-large --seq 128
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_TFLOPS = 64.0       # 1x V100, BERT-L seq 128
+BASELINE_SAMPLES_SEC = 272.0
+
+
+def run(model_name: str = "bert-large", seq: int = 128, micro: int = 64,
+        remat: bool = True, remat_policy: str = "selective",
+        steps: int = 10) -> dict:
+    """Train-step throughput; all reported numbers are PER DEVICE."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.bert import BertForPreTraining, bert_config
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    cfg = bert_config(model_name, dtype=jnp.bfloat16, scan_layers=True,
+                      remat=remat, remat_policy=remat_policy)
+    model = BertForPreTraining(cfg)
+    ds = {"train_micro_batch_size_per_gpu": micro,
+          "gradient_accumulation_steps": 1, "bf16": {"enabled": True},
+          "gradient_clipping": 1.0,
+          "optimizer": {"type": "FusedAdam",
+                        "params": {"lr": 1e-4, "weight_decay": 0.01}},
+          "steps_per_print": 10 ** 9}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds)
+    n_dev = engine.topology.num_devices
+    gb = micro * engine.topology.data_parallel_size
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(gb, seq)).astype(np.int32)
+    labels = np.where(rng.rand(gb, seq) < 0.15, ids, -100).astype(np.int32)
+    batch = {"input_ids": ids, "labels": labels}
+    it = iter(RepeatingLoader([batch]))
+
+    def fence():
+        # scalar-only host read: on tunneled backends block_until_ready can
+        # return before the compute queue drains; a device-side reduction
+        # read back as one float is the only honest fence
+        return float(jnp.sum(jax.tree.leaves(engine.params)[0]
+                             .astype(jnp.float32)))
+
+    engine.train_batch(it)
+    engine.train_batch(it)
+    fence()
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(it)
+    fence()
+    dt = (time.time() - t0) / steps
+
+    C, L, I = (cfg.hidden_size, cfg.num_hidden_layers,
+               cfg.intermediate_size)
+    # non-embedding params: encoder + MLM transform
+    n_nonembed = L * (4 * C * C + 2 * C * I + 13 * C) + C * C + 3 * C
+    attn = 12 * L * C * seq  # bidirectional attention, fwd+bwd
+    flops_per_token = 6.0 * n_nonembed + attn
+    tokens = gb * seq
+    return {
+        "model": model_name, "seq": seq, "global_batch": gb,
+        "n_devices": n_dev,
+        "samples_per_sec": round(gb / dt / n_dev, 1),
+        "ms_per_step": round(dt * 1000, 1),
+        "model_tflops": round(tokens * flops_per_token / dt / 1e12 / n_dev,
+                              2),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="bert-large")
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--micro", type=int, default=64)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--remat-policy", default="selective",
+                   choices=["full", "selective"])
+    args = p.parse_args()
+    out = run(args.model, args.seq, args.micro, remat=not args.no_remat,
+              remat_policy=args.remat_policy, steps=args.steps)
+    out["vs_v100_baseline_tflops"] = round(
+        out["model_tflops"] / BASELINE_TFLOPS, 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

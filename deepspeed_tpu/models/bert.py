@@ -31,6 +31,11 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # "full" recomputes everything; "selective" saves the non-batched
+    # param matmul outputs (attention einsums are still recomputed — the
+    # policy's flash-attention checkpoint names only exist in the GPT
+    # trunk; see transformer_lm._remat_policy)
+    remat_policy: str = "full"
     scan_layers: bool = True
 
     @property
@@ -106,7 +111,10 @@ class BertEncoder(nn.Module):
         if cfg.scan_layers:
             layer_cls = BertLayer
             if cfg.remat:
-                layer_cls = nn.remat(BertLayer, prevent_cse=False)
+                from deepspeed_tpu.models.transformer_lm import _remat_policy
+
+                layer_cls = nn.remat(BertLayer, prevent_cse=False,
+                                     policy=_remat_policy(cfg.remat_policy))
 
             def body(layer, carry):
                 x, mask = carry
@@ -121,8 +129,14 @@ class BertEncoder(nn.Module):
             )
             (x, _), _ = scanned(layer_cls(cfg, name="layer"), (x, mask))
             return x
+        layer_cls = BertLayer
+        if cfg.remat:
+            from deepspeed_tpu.models.transformer_lm import _remat_policy
+
+            layer_cls = nn.remat(BertLayer, prevent_cse=False,
+                                 policy=_remat_policy(cfg.remat_policy))
         for i in range(cfg.num_hidden_layers):
-            x = BertLayer(cfg, name=f"layer_{i}")(x, mask, deterministic)
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
         return x
 
 
