@@ -390,7 +390,9 @@ class DeepSpeedConfig:
         self.compression_training = pd.get(C.COMPRESSION_TRAINING, {})
         self.data_efficiency = pd.get(C.DATA_EFFICIENCY, {})
         self.quantize_training = pd.get(C.QUANTIZE_TRAINING, {})
-        self.nebula = pd.get(C.NEBULA, {})
+        from deepspeed_tpu.nebula import NebulaConfig
+
+        self.nebula = NebulaConfig.from_dict(pd.get(C.NEBULA, {}))
         ckpt = pd.get(C.CHECKPOINT, {}) or {}
         self.checkpoint_tag_validation = str(
             ckpt.get(C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT)

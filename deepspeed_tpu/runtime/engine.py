@@ -228,7 +228,11 @@ class DeepSpeedEngine:
         self._tx = self._configure_optimizer(optimizer)
         self.optimizer_adapter = OptimizerAdapter(self)
 
-        self.checkpoint_engine: CheckpointEngine = MsgpackCheckpointEngine()
+        from deepspeed_tpu.runtime.checkpoint_engine import \
+            select_checkpoint_engine
+
+        self.checkpoint_engine: CheckpointEngine = \
+            select_checkpoint_engine(config)
 
         # runtime state (device) — params/opt created lazily at first batch
         self._params = None
@@ -918,10 +922,12 @@ class DeepSpeedEngine:
         self.checkpoint_engine.save(
             optim_state, self._optim_states_path(save_dir, tag)
         )
+        # commit BEFORE advertising 'latest': with the async engine the
+        # pointer must never name a tag whose files haven't durably landed
+        self.checkpoint_engine.commit(tag)
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
-        self.checkpoint_engine.commit(tag)
         return True
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.msgpack"):

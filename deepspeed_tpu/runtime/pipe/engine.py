@@ -149,7 +149,10 @@ class PipelineEngine:
                 self._schedule_fn, use_pallas=config.tpu.use_pallas_optimizer)
         self.optimizer_adapter = self._tx  # returned from initialize()
 
-        self.checkpoint_engine = MsgpackCheckpointEngine()
+        from deepspeed_tpu.runtime.checkpoint_engine import \
+            select_checkpoint_engine
+
+        self.checkpoint_engine = select_checkpoint_engine(config)
         self._rng = jax.random.PRNGKey(seed)
         self._initialized = False
         self.global_steps = 0
