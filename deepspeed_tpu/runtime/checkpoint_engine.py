@@ -61,17 +61,22 @@ def select_checkpoint_engine(config) -> "CheckpointEngine":
     return MsgpackCheckpointEngine()
 
 
+def _write_atomic(host_state, path: str):
+    """Serialize + atomically replace ``path`` (shared by sync and async
+    engines so durability fixes land in one place)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = serialization.msgpack_serialize(host_state)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
 class MsgpackCheckpointEngine(CheckpointEngine):
     """Default engine: flax msgpack files (≈ TorchCheckpointEngine)."""
 
     def save(self, state_dict: Dict[str, Any], path: str):
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        host_state = _to_host(state_dict)
-        payload = serialization.msgpack_serialize(host_state)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)
+        _write_atomic(_to_host(state_dict), path)
         log_dist(f"[ckpt] saved {path}", ranks=[0])
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
@@ -109,12 +114,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 return
             host_state, path, done = item
             try:
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                payload = serialization.msgpack_serialize(host_state)
-                tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(payload)
-                os.replace(tmp, path)
+                _write_atomic(host_state, path)
                 log_dist(f"[ckpt] async saved {path}", ranks=[0])
             except Exception as e:  # surfaced at commit()
                 self._errors.append((path, e))
@@ -129,6 +129,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
         self.wait()  # never read a file a pending write may still replace
+        self._raise_errors()  # a failed write leaves a STALE file behind
         with open(path, "rb") as f:
             return serialization.msgpack_restore(f.read())
 
@@ -137,13 +138,16 @@ class AsyncCheckpointEngine(CheckpointEngine):
             done.wait()
         self._pending = []
 
-    def commit(self, tag: str) -> bool:
-        self.wait()
+    def _raise_errors(self):
         if self._errors:
             path, err = self._errors[0]
             self._errors = []
             raise RuntimeError(f"async checkpoint write failed for {path}"
                                ) from err
+
+    def commit(self, tag: str) -> bool:
+        self.wait()
+        self._raise_errors()
         log_dist(f"[ckpt] tag {tag} committed (all async writes durable)",
                  ranks=[0])
         return True

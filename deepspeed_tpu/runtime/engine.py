@@ -41,7 +41,7 @@ from deepspeed_tpu.parallel.mesh import (
 )
 from deepspeed_tpu.runtime.checkpoint_engine import (
     CheckpointEngine,
-    MsgpackCheckpointEngine,
+    select_checkpoint_engine,
 )
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
@@ -227,9 +227,6 @@ class DeepSpeedEngine:
         self._client_optimizer = optimizer
         self._tx = self._configure_optimizer(optimizer)
         self.optimizer_adapter = OptimizerAdapter(self)
-
-        from deepspeed_tpu.runtime.checkpoint_engine import \
-            select_checkpoint_engine
 
         self.checkpoint_engine: CheckpointEngine = \
             select_checkpoint_engine(config)
@@ -494,6 +491,13 @@ class DeepSpeedEngine:
 
             def do_update(operand):
                 params, opt_state, grads = operand
+                # grads ride in f32 for overflow/clip math; the optimizer
+                # consumes them in each param's dtype so moment buffers keep
+                # the dtype they were initialized with (pure-bf16 training:
+                # param_dtype=bf16 means bf16 m/v — the lax.cond skip branch
+                # must see identical state types)
+                grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                                     grads, params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
                 return new_params, new_opt
@@ -551,6 +555,9 @@ class DeepSpeedEngine:
 
             def do_update(operand):
                 params, opt_state, grads = operand
+                # see _build_apply.do_update: optimizer math in param dtype
+                grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                                     grads, params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 return optax.apply_updates(params, updates), new_opt
 

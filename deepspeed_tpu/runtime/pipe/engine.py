@@ -34,7 +34,7 @@ from flax import linen as nn
 from flax import serialization
 
 from deepspeed_tpu.parallel.mesh import MeshTopology, set_default_topology
-from deepspeed_tpu.runtime.checkpoint_engine import MsgpackCheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine import select_checkpoint_engine
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
 from deepspeed_tpu.runtime.lr_schedules import (
@@ -148,9 +148,6 @@ class PipelineEngine:
                 config.optimizer.type, config.optimizer.params,
                 self._schedule_fn, use_pallas=config.tpu.use_pallas_optimizer)
         self.optimizer_adapter = self._tx  # returned from initialize()
-
-        from deepspeed_tpu.runtime.checkpoint_engine import \
-            select_checkpoint_engine
 
         self.checkpoint_engine = select_checkpoint_engine(config)
         self._rng = jax.random.PRNGKey(seed)
@@ -481,6 +478,8 @@ class PipelineEngine:
                 os.path.join(save_dir, str(tag),
                              f"layer_bounds_{self.stage_bounds[s]}_"
                              f"{self.stage_bounds[s+1]}_model_states.msgpack"))
+        # durability barrier BEFORE advertising 'latest' (async engine)
+        self.checkpoint_engine.commit(tag)
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))

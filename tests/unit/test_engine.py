@@ -225,3 +225,21 @@ def test_gpt_remat_trains(eight_devices, policy, scan):
     batches = random_token_batches(4, 16, 32, 128)  # 2 per chip x dp 8
     losses = [float(engine.train_batch(iter([b]))) for b in batches]
     assert all(np.isfinite(losses))
+
+
+def test_pure_bf16_param_dtype_trains(eight_devices):
+    """Regression: with param_dtype=bf16 (pure-bf16 training — how GPT-2
+    1.3B fits one chip) the optimizer must consume grads in the param
+    dtype, or the overflow lax.cond branches disagree on moment dtypes."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.transformer_lm import GPT
+
+    cfg = tiny_gpt_config(param_dtype=jnp.bfloat16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(cfg), config=base_config(train_micro_batch_size_per_gpu=2))
+    batches = random_token_batches(4, 16, 32, 128)
+    losses = [float(engine.train_batch(iter([b]))) for b in batches]
+    assert all(np.isfinite(losses))
+    leaf = jax.tree.leaves(engine.params)[0]
+    assert leaf.dtype == jnp.bfloat16
