@@ -199,6 +199,9 @@ class TestOnebitVariants:
         params = {"w": jnp.asarray(0.1 * rng.randn(16), jnp.float32)}
         state = tx.init(params)
 
+        # the whole fit is ONE dispatch (lax.scan over steps): exercises the
+        # compressed collectives identically but avoids hammering the CPU
+        # client with hundreds of rapid shard_map dispatches
         @jax.jit
         @functools.partial(
             shard_map, mesh=mesh,
@@ -206,14 +209,21 @@ class TestOnebitVariants:
                       P("dp", None), P("dp")),
             out_specs=(P(), jax.tree.map(lambda _: P(), state)),
             check_vma=False)
-        def step(params, state, xb, yb):
-            g = jax.grad(lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(params)
-            u, state = tx.update(g, state, params)
-            return jax.tree.map(lambda p, du: p + du, params, u), state
+        def fit(params, state, xb, yb):
+            def body(carry, _):
+                params, state = carry
+                g = jax.grad(
+                    lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(params)
+                u, state = tx.update(g, state, params)
+                params = jax.tree.map(lambda p, du: p + du, params, u)
+                return (params, state), ()
+
+            (params, state), _ = jax.lax.scan(
+                body, (params, state), None, length=steps)
+            return params, state
 
         l0 = float(np.mean((X @ np.asarray(params["w"]) - y) ** 2))
-        for _ in range(steps):
-            params, state = step(params, state, X, y)
+        params, state = fit(params, state, X, y)
         l1 = float(np.mean((X @ np.asarray(params["w"]) - y) ** 2))
         return l0, l1
 
