@@ -1,16 +1,22 @@
 #!/usr/bin/env python
-"""Benchmark: BERT-Large MLM pretraining throughput on one TPU chip.
+"""Benchmarks: the two BASELINE headline workloads on one TPU chip.
 
-The reference's headline single-device number is 64 TFLOPS / 272
-samples-per-sec for BERT-Large at seq 128 on one V100 (BASELINE.md,
-reference docs/_posts/2020-05-28-fastest-bert-training.md:36) — this is
-the SAME workload measured the same way (see benchmarks/bert_pretrain.py,
-which owns the harness). Prints ONE JSON line:
+Prints one JSON line per workload,
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+with the north-star metric LAST:
 
-GPT-2 family training benches: benchmarks/train_sweep.py (350M reaches
-~70 TFLOPS), long-context: benchmarks/long_context.py, inference latency:
-benchmarks/inference/gpt_bench.py.
+1. BERT-Large MLM pretrain, seq 128 — the reference's headline
+   single-device number is 64 TFLOPS / 272 samples-per-sec on one V100
+   (BASELINE.md, reference docs/_posts/2020-05-28-fastest-bert-training.md:36).
+   Harness: benchmarks/bert_pretrain.py.
+2. GPT-2 1.3B pretrain (BASELINE "Target configs" #3, the north star) —
+   pure-bf16, largest single-chip training config; vs_baseline is the
+   reference's single-device model-at-the-memory-limit number (ZeRO-Offload
+   >30 TFLOPS on one V100, docs/_pages/training.md:293).
+   Harness: benchmarks/gpt_pretrain.py.
+
+Other harnesses: benchmarks/train_sweep.py, benchmarks/long_context.py,
+benchmarks/inference/gpt_bench.py, benchmarks/communication/run_all.py.
 """
 
 import json
@@ -18,30 +24,46 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
-from benchmarks.bert_pretrain import (  # noqa: E402
-    BASELINE_SAMPLES_SEC,
-    BASELINE_TFLOPS,
-    run,
-)
+from benchmarks import bert_pretrain, gpt_pretrain  # noqa: E402
 
 
 def main():
-    r = run("bert-large", seq=128, micro=64, remat=True,
-            remat_policy="selective", steps=10)
-    result = {
+    r = bert_pretrain.run("bert-large", seq=128, micro=64, remat=True,
+                          remat_policy="selective", steps=10)
+    print(json.dumps({
         "metric": "bert_large_seq128_train_tflops_per_chip",
         "value": r["model_tflops"],
         "unit": "TFLOPS",
-        "vs_baseline": round(r["model_tflops"] / BASELINE_TFLOPS, 3),
+        "vs_baseline": round(
+            r["model_tflops"] / bert_pretrain.BASELINE_TFLOPS, 3),
         "samples_per_sec": r["samples_per_sec"],
         "samples_per_sec_vs_baseline": round(
-            r["samples_per_sec"] / BASELINE_SAMPLES_SEC, 3),
+            r["samples_per_sec"] / bert_pretrain.BASELINE_SAMPLES_SEC, 3),
         "ms_per_step": r["ms_per_step"],
         "seq_len": r["seq"],
         "global_batch": r["global_batch"],
         "n_devices": r["n_devices"],
-    }
-    print(json.dumps(result))
+    }), flush=True)
+
+    # free the BERT engine's device buffers (engine<->adapter cycle needs a
+    # GC pass) before the 1.3B model takes nearly all of HBM
+    import gc
+
+    gc.collect()
+
+    g = gpt_pretrain.run()
+    print(json.dumps({
+        "metric": "gpt2_1.3b_seq1024_train_tflops_per_chip",
+        "value": g["model_tflops"],
+        "unit": "TFLOPS",
+        "vs_baseline": round(
+            g["model_tflops"] / gpt_pretrain.BASELINE_TFLOPS, 3),
+        "samples_per_sec": g["samples_per_sec"],
+        "ms_per_step": g["ms_per_step"],
+        "seq_len": g["seq"],
+        "global_batch": g["global_batch"],
+        "n_devices": g["n_devices"],
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,15 @@
+"""Shared benchmark helpers."""
+
+import jax
+import jax.numpy as jnp
+
+
+def fence(tree):
+    """Drain the device queue before reading the wall clock.
+
+    ``block_until_ready`` can return before the accelerator compute queue
+    drains on the tunneled transport, so fence with a scalar host read of a
+    device-side reduction instead (a full-array transfer would poison the
+    measurement).
+    """
+    return float(jnp.sum(jax.tree.leaves(tree)[0].astype(jnp.float32)))

@@ -18,6 +18,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmarks._util import fence  # noqa: E402
+
 BASELINE_TFLOPS = 64.0       # 1x V100, BERT-L seq 128
 BASELINE_SAMPLES_SEC = 272.0
 
@@ -53,20 +55,14 @@ def run(model_name: str = "bert-large", seq: int = 128, micro: int = 64,
     batch = {"input_ids": ids, "labels": labels}
     it = iter(RepeatingLoader([batch]))
 
-    def fence():
-        # scalar-only host read: on tunneled backends block_until_ready can
-        # return before the compute queue drains; a device-side reduction
-        # read back as one float is the only honest fence
-        return float(jnp.sum(jax.tree.leaves(engine.params)[0]
-                             .astype(jnp.float32)))
 
     engine.train_batch(it)
     engine.train_batch(it)
-    fence()
+    fence(engine.params)
     t0 = time.time()
     for _ in range(steps):
         engine.train_batch(it)
-    fence()
+    fence(engine.params)
     dt = (time.time() - t0) / steps
 
     C, L, I = (cfg.hidden_size, cfg.num_hidden_layers,

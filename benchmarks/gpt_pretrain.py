@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""GPT-2 1.3B single-chip pretraining throughput (BASELINE north star).
+
+BASELINE.md's primary metric is "GPT-2 1.3B ZeRO-3 samples/sec/chip +
+TFLOPS". On one chip the ZeRO axes are degenerate (dp=1), so this measures
+the per-chip number the multi-chip run is normalised by. 1.3B only fits in
+~12 GB HBM with pure-bf16 training (bf16 params AND bf16 Adam moments, no
+fp32 masters — see README "Single-chip capacity"); that is the config
+benched here.
+
+Comparable published reference number: ZeRO-Offload trains a
+bigger-than-HBM model on ONE V100 at >30 TFLOPS (reference
+docs/_pages/training.md:293) — the same "single device, model at the
+memory limit" story. vs_baseline uses that 30-TFLOPS figure.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from benchmarks._util import fence
+from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config, num_params
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+BASELINE_TFLOPS = 30.0  # ZeRO-Offload, 1x V100: docs/_pages/training.md:293
+
+
+def run(model_name="gpt2-1.3b", seq=1024, micro=4, steps=6,
+        remat_policy="full"):
+    # measured on the v5e chip (micro x policy sweep): micro 4 / full remat =
+    # 81.2 TFLOPS; micro 2 full = 73.9; selective remat OOMs at any micro;
+    # micro >= 5 OOMs. Full remat wins because 1.3B leaves <2 GB for
+    # activations after bf16 params+grads+moments (~10.4 GB).
+    cfg = gpt2_config(
+        model_name, n_positions=seq, dtype=jnp.bfloat16,
+        param_dtype=jnp.bfloat16, scan_layers=True, remat=True,
+        remat_policy=remat_policy)
+    model = GPT(cfg)
+    ds_config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 2e-4, "betas": [0.9, 0.95],
+                                 "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
+    gb = micro * engine.topology.data_parallel_size
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, cfg.vocab_size,
+                                      size=(gb, seq)).astype(np.int32)}
+    batch["labels"] = batch["input_ids"]
+    it = iter(RepeatingLoader([batch]))
+
+    engine.train_batch(it)
+    engine.train_batch(it)
+    fence(engine.params)
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(it)
+    fence(engine.params)
+    dt = (time.time() - t0) / steps
+
+    n_params = num_params(cfg)
+    embed = cfg.vocab_size * cfg.n_embd
+    attn = 6 * cfg.n_layer * cfg.n_embd * seq
+    fpt = 6.0 * (n_params - embed) + attn
+    n_dev = len(jax.devices())
+    return {
+        "model": model_name,
+        "n_params": n_params,
+        "model_tflops": round(gb * seq * fpt / dt / 1e12 / n_dev, 2),
+        "samples_per_sec": round(gb / dt / n_dev, 2),
+        "ms_per_step": round(dt * 1000, 1),
+        "seq": seq,
+        "global_batch": gb,
+        "n_devices": n_dev,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    micro = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    print(json.dumps(run(micro=micro)))

@@ -18,6 +18,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmarks._util import fence  # noqa: E402
+
 
 def run(seq: int, micro: int):
     import jax
@@ -50,19 +52,16 @@ def run(seq: int, micro: int):
     b["labels"] = b["input_ids"]
     it = iter(RepeatingLoader([b]))
 
-    def fence():
-        return float(jnp.sum(jax.tree.leaves(engine.params)[0]
-                             .astype(jnp.float32)))
 
     try:
         engine.train_batch(it)
         engine.train_batch(it)
-        fence()
+        fence(engine.params)
         steps = 5
         t0 = time.time()
         for _ in range(steps):
             engine.train_batch(it)
-        fence()
+        fence(engine.params)
         dt = (time.time() - t0) / steps
     except Exception as e:
         print(json.dumps({"seq": seq, "micro": micro,

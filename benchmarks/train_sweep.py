@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
+from benchmarks._util import fence
 from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config, num_params
 from deepspeed_tpu.runtime.dataloader import RepeatingLoader
 
@@ -42,19 +43,16 @@ def run(micro, remat, policy, flash):
     batch["labels"] = batch["input_ids"]
     it = iter(RepeatingLoader([batch]))
 
-    def fence():
-        return float(jnp.sum(jax.tree.leaves(engine.params)[0]
-                             .astype(jnp.float32)))
 
     try:
         engine.train_batch(it)
         engine.train_batch(it)
-        fence()
+        fence(engine.params)
         steps = 6
         t0 = time.time()
         for _ in range(steps):
             engine.train_batch(it)
-        fence()
+        fence(engine.params)
         dt = (time.time() - t0) / steps
     except Exception as e:  # OOM etc
         print(json.dumps({"micro": micro, "remat": remat, "policy": policy,
