@@ -47,7 +47,10 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     relative error), plus — with ``return_error=True`` — the local phase-1
     quantization residual ``x - dequant(quant(x))`` to carry as error
     feedback into the next step's tensor (the 1-bit Adam pattern,
-    runtime/fp16/onebit/adam.py).
+    runtime/fp16/onebit/adam.py). The residual is returned in float32
+    regardless of ``x``'s dtype: error feedback must accumulate in full
+    precision (a bf16 round-trip would drop most of the residual's
+    mantissa and defeat the compensation).
     """
     w = lax.axis_size(axis)
     shape, dtype = x.shape, x.dtype
@@ -83,11 +86,12 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     err = flat - dequantize(q, s)
     if pad:
         err = err[:n]
-    return out, err.reshape(shape).astype(dtype)
+    return out, err.reshape(shape)
 
 
 def quantization_error(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
-    """Residual ``x - dequant(quant(x))`` for error-feedback loops."""
+    """Residual ``x - dequant(quant(x))`` for error-feedback loops
+    (float32 — see :func:`quantized_all_reduce`)."""
     flat = x.astype(jnp.float32).ravel()
     n = flat.size
     pad = (-n) % block
@@ -97,4 +101,4 @@ def quantization_error(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:
     err = flat - dequantize(q, s)
     if pad:
         err = err[:n]
-    return err.reshape(x.shape).astype(x.dtype)
+    return err.reshape(x.shape)

@@ -352,6 +352,16 @@ class DeepSpeedConfig:
         self.zero_allow_untested_optimizer = get_scalar_param(
             pd, C.ZERO_ALLOW_UNTESTED_OPTIMIZER, C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT
         )
+        self.communication_data_type = get_scalar_param(
+            pd, C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT
+        )
+        if self.communication_data_type is not None and (
+                self.communication_data_type not in C.COMMUNICATION_DATA_TYPES):
+            raise DeepSpeedConfigError(
+                f"Invalid {C.COMMUNICATION_DATA_TYPE}. Supported: "
+                f"{C.COMMUNICATION_DATA_TYPES}. "
+                f"Got: {self.communication_data_type}"
+            )
 
         self.fp16 = Fp16Config.from_dict(pd.get(C.FP16, {}))
         bf16_block = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))

@@ -160,9 +160,17 @@ TPU_MESH = "mesh"
 TPU_REMAT = "remat"
 TPU_DONATE = "donate_params"
 
+# Gradient-allreduce wire format (reference runtime/config.py
+# get_communication_data_type + runtime/comm/nccl.py compressed path).
+# "int8" routes the data-parallel gradient exchange through the quantized
+# collectives in comm/compressed.py (EQuARX-style); fp16/bfp16/fp32 are
+# accepted for config parity (XLA reduces in the compute dtype).
+COMMUNICATION_DATA_TYPE = "communication_data_type"
+COMMUNICATION_DATA_TYPE_DEFAULT = None
+COMMUNICATION_DATA_TYPES = ["fp16", "bfp16", "bf16", "fp32", "int8"]
+
 # Routing of reference GPU-only keys we accept but ignore (documented no-ops).
 IGNORED_GPU_ONLY_KEYS = [
-    "communication_data_type",
     "fp16.auto_cast",
     "hybrid_engine",
 ]

@@ -56,7 +56,10 @@ from deepspeed_tpu.runtime.lr_schedules import (
     build_lr_scheduler,
     schedule_fn_from_config,
 )
-from deepspeed_tpu.runtime.optimizer import build_optimizer
+from deepspeed_tpu.runtime.optimizer import (
+    build_optimizer,
+    is_compressed_optimizer,
+)
 from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -181,12 +184,29 @@ class DeepSpeedEngine:
 
         if topology is None:
             topology = topology_from_config(config.tpu.mesh_config)
+        # Compressed gradient exchange (reference runtime/fp16/onebit +
+        # runtime/comm/nccl.py:51): either a 1-bit optimizer type or
+        # communication_data_type=int8. Both replace XLA's implicit grad
+        # averaging with an explicit shard_mapped exchange over the
+        # data-parallel axis, so the step keeps PER-WORKER gradients.
+        self._compressed_mode = None
+        self._comp_k = None
+        if optimizer is None and is_compressed_optimizer(config.optimizer.type):
+            self._compressed_mode = "onebit"
+        elif config.communication_data_type == "int8":
+            self._compressed_mode = "int8"
+        if self._compressed_mode is not None:
+            self._validate_compressed_config(config, topology)
         # ZeRO shards over the fsdp axis: when the user asked for a ZeRO stage
         # but left all data parallelism on `dp`, move it to `fsdp` (the mesh
         # expression of "partition across the DP world",
-        # reference stage_1_and_2.py partitioning over the DP group)
+        # reference stage_1_and_2.py partitioning over the DP group).
+        # Compressed modes keep the axis on `dp`: the exchange needs the
+        # full momentum/gradient materialized per worker (reference 1-bit
+        # optimizers are likewise limited to ZeRO stages 0-1, onebit/adam.py).
         if (config.zero_config.stage >= 1 and topology.size("fsdp") == 1
-                and topology.size("dp") > 1):
+                and topology.size("dp") > 1
+                and self._compressed_mode is None):
             sizes = dict(topology.axis_sizes)
             sizes["fsdp"] = sizes.pop("dp")
             sizes["dp"] = 1
@@ -310,6 +330,42 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # configuration
     # ------------------------------------------------------------------
+    def _validate_compressed_config(self, config, topology):
+        """Constraints shared by the 1-bit optimizers and int8 grad comm."""
+        mode = self._compressed_mode
+        if config.fp16.enabled:
+            raise ValueError(
+                f"{mode} compressed gradient exchange does not support fp16 "
+                "dynamic loss scaling; use bf16 (TPU-native) or fp32")
+        max_stage = 1 if mode == "onebit" else 0
+        if config.zero_config.stage > max_stage:
+            raise ValueError(
+                f"{mode} compressed gradient exchange requires ZeRO stage "
+                f"<= {max_stage} (got {config.zero_config.stage}); the "
+                "exchange needs the full gradient/momentum per worker — "
+                "same limitation as the reference 1-bit optimizers")
+        for ax in ("fsdp", "tp", "pp", "sp", "ep"):
+            if topology.size(ax) > 1:
+                raise ValueError(
+                    f"compressed gradient exchange runs over the dp axis "
+                    f"only; mesh axis {ax!r} has size {topology.size(ax)}")
+        off = (config.zero_config.offload_optimizer or {}).get("device", "none")
+        if off != "none":
+            raise ValueError(
+                f"{mode} compressed gradient exchange cannot combine with "
+                "offload_optimizer (the host step bypasses the exchange)")
+        if config.gradient_clipping:
+            logger.warning(
+                "gradient_clipping is ignored with %s compressed exchange: "
+                "the global norm of the averaged gradient is never "
+                "materialized (divergence documented in docs/DIVERGENCES.md)",
+                mode)
+        if mode == "onebit" and config.zero_config.stage == 1:
+            log_dist(
+                "OnebitAdam with ZeRO stage 1: optimizer state stays "
+                "replicated (the compressed exchange materializes the full "
+                "momentum per worker)", ranks=[0])
+
     def _configure_lr(self, lr_scheduler):
         cfg = self._config
         if lr_scheduler is None and cfg.scheduler.type is not None:
@@ -331,9 +387,13 @@ class DeepSpeedEngine:
                 "reference's torch.optim objects have no TPU meaning"
             )
         lr = self._schedule_fn  # None -> use params lr
+        kw = {}
+        if self._compressed_mode == "onebit":
+            kw = dict(compression_axis="dp",
+                      compression_axis_size=self.topology.size("dp"))
         return build_optimizer(
             cfg.optimizer.type, cfg.optimizer.params, lr,
-            use_pallas=cfg.tpu.use_pallas_optimizer,
+            use_pallas=cfg.tpu.use_pallas_optimizer, **kw,
         )
 
     def _configure_monitor(self):
@@ -421,6 +481,8 @@ class DeepSpeedEngine:
                           if self._offload_device == "nvme" else None))
             self._opt_shardings = None
             self._opt_state = None
+        elif self._compressed_mode is not None:
+            self._init_compressed_state(param_shapes)
         else:
             opt_shapes = jax.eval_shape(self._tx.init, param_shapes)
             self._opt_shardings = self.sharding_rules.opt_sharding_tree(
@@ -430,7 +492,10 @@ class DeepSpeedEngine:
                 self._tx.init, out_shardings=self._opt_shardings
             )(self._params)
         self._acc_grads = jax.jit(
-            lambda p: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), p),
+            lambda p: jax.tree.map(
+                lambda x: jnp.zeros(
+                    ((self._comp_k,) + x.shape) if self._compressed_mode
+                    else x.shape, jnp.float32), p),
             out_shardings=self._grad_shardings,
         )(self._params)
         self._initialized = True
@@ -442,9 +507,186 @@ class DeepSpeedEngine:
         )
 
     # ------------------------------------------------------------------
+    # compressed gradient exchange (1-bit optimizers / int8 grad comm)
+    # ------------------------------------------------------------------
+    def _init_compressed_state(self, param_shapes):
+        """State for the shard_mapped compressed step.
+
+        Gradients (and their accumulation buffer) carry a leading
+        ``dp``-sized group axis — each worker's UNAVERAGED gradient, which
+        the exchange consumes (the compression IS the allreduce; reference
+        runtime/comm/nccl.py:51). Per-worker error-feedback buffers shard
+        over dp; everything else is replicated.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.topology.mesh
+        axis = "dp"
+        self._comp_k = self.topology.size(axis)
+        pw = NamedSharding(mesh, P(axis))
+        self._grad_shardings = jax.tree.map(lambda _: pw, param_shapes)
+        self._param_specs = jax.tree.map(lambda _: P(), param_shapes)
+        self._grad_specs = jax.tree.map(lambda _: P(axis), param_shapes)
+
+        if self._compressed_mode == "onebit":
+            st_shape = jax.eval_shape(self._tx.init, param_shapes)
+            cls = type(st_shape)
+            rep = lambda t: jax.tree.map(lambda _: P(), t)  # noqa: E731
+            dp_ = lambda t: jax.tree.map(lambda _: P(axis), t)  # noqa: E731
+            self._opt_specs = cls(
+                count=P(), exp_avg=rep(st_shape.exp_avg),
+                exp_avg_sq=rep(st_shape.exp_avg_sq),
+                worker_error=dp_(st_shape.worker_error),
+                server_error=dp_(st_shape.server_error))
+            tx = self._tx
+
+            def init_global(params):
+                st = tx.init(params)
+                # per-worker buffers gain the leading group axis
+                return st._replace(
+                    worker_error=jax.tree.map(
+                        lambda x: x[None], st.worker_error),
+                    server_error=jax.tree.map(
+                        lambda x: x[None], st.server_error))
+
+            self._opt_state = jax.jit(jax.shard_map(
+                init_global, mesh=mesh, in_specs=(self._param_specs,),
+                out_specs=self._opt_specs, check_vma=False))(self._params)
+        else:  # int8 quantized grad allreduce, any optax optimizer
+            inner = jax.jit(self._tx.init)(self._params)
+            err = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.zeros((self._comp_k,) + x.shape,
+                                        jnp.float32), p),
+                out_shardings=self._grad_shardings)(self._params)
+            self._opt_state = (inner, err)
+            self._opt_specs = (
+                jax.tree.map(lambda _: P(), inner),
+                jax.tree.map(lambda _: P(axis), err))
+        self._opt_shardings = jax.tree.map(
+            lambda x: x.sharding, self._opt_state)
+
+    def _compressed_apply_core(self):
+        """shard_map program: per-worker grads -> compressed exchange ->
+        optimizer update -> replicated new params."""
+        from jax.sharding import PartitionSpec as P
+
+        tx = self._tx
+        mesh = self.topology.mesh
+        k = self._comp_k
+        mode = self._compressed_mode
+
+        def apply_step(params, opt_state, grads_pw):
+            local_g = jax.tree.map(lambda g: g[0], grads_pw)  # [1,*s]->[*s]
+            if mode == "onebit":
+                st = opt_state._replace(
+                    worker_error=jax.tree.map(
+                        lambda x: x[0], opt_state.worker_error),
+                    server_error=jax.tree.map(
+                        lambda x: x[0], opt_state.server_error))
+                # grads stay f32: the 1-bit state (momentum, errors) is f32
+                updates, new_st = tx.update(local_g, st, params)
+                new_params = optax.apply_updates(params, updates)
+                new_opt = new_st._replace(
+                    worker_error=jax.tree.map(
+                        lambda x: x[None], new_st.worker_error),
+                    server_error=jax.tree.map(
+                        lambda x: x[None], new_st.server_error))
+            else:
+                from deepspeed_tpu.comm.compressed import quantized_all_reduce
+
+                inner, err = opt_state
+                reduced, new_err = [], []
+                flat_g, treedef = jax.tree.flatten(local_g)
+                for g, e in zip(flat_g, jax.tree.leaves(err)):
+                    r, e2 = quantized_all_reduce(
+                        g + e[0], "dp", return_error=True)
+                    reduced.append(r / k)
+                    new_err.append(e2[None])
+                mean_g = jax.tree.unflatten(treedef, reduced)
+                mean_g = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                                      mean_g, params)
+                updates, new_inner = tx.update(mean_g, inner, params)
+                new_params = optax.apply_updates(params, updates)
+                new_opt = (new_inner, jax.tree.unflatten(treedef, new_err))
+            return new_params, new_opt
+
+        return jax.shard_map(
+            apply_step, mesh=mesh,
+            in_specs=(self._param_specs, self._opt_specs, self._grad_specs),
+            out_specs=(self._param_specs, self._opt_specs),
+            check_vma=False)
+
+    def _grouped_grads(self, params, batch, rng, step, loss_scale):
+        """Per-worker gradients via a vmap over dp-sized batch groups: each
+        group's gradient only depends on its batch shard, so the [k, ...]
+        output shards over dp with NO collective — the exchange in the apply
+        step is the only cross-worker traffic. Trace-level helper shared by
+        the fused and unfused compressed step builders."""
+        model = self.module
+        k = self._comp_k
+        rng = jax.random.fold_in(rng, step)
+        rngs = jax.random.split(rng, k)
+
+        def loss_fn(p, local_batch, r):
+            loss = model.apply(
+                {"params": p}, **local_batch, deterministic=False,
+                rngs={"dropout": r, "gating": jax.random.fold_in(r, 7)},
+            )
+            return loss * loss_scale, loss
+
+        grouped = jax.tree.map(
+            lambda x: x.reshape((k, x.shape[0] // k) + x.shape[1:]), batch)
+        grads, losses = jax.vmap(
+            jax.grad(loss_fn, has_aux=True), in_axes=(None, 0, 0)
+        )(params, grouped, rngs)
+        return grads, jnp.mean(losses)
+
+    def _build_fwd_bwd_compressed(self):
+        gas = self.gradient_accumulation_steps
+
+        def fwd_bwd(params, acc_grads, batch, rng, step, scale):
+            grads, loss = self._grouped_grads(
+                params, batch, rng, step, scale / gas)
+            new_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc_grads, grads)
+            return new_acc, loss
+
+        return jax.jit(
+            fwd_bwd,
+            donate_argnums=(1,),
+            out_shardings=(self._grad_shardings, None),
+        )
+
+    def _build_apply_compressed(self):
+        core = self._compressed_apply_core()
+
+        def apply_step(params, opt_state, acc_grads, ls_state):
+            new_params, new_opt = core(params, opt_state, acc_grads)
+            zero_acc = jax.tree.map(jnp.zeros_like, acc_grads)
+            return (new_params, new_opt, zero_acc, ls_state,
+                    jnp.bool_(False), jnp.float32(0.0))
+
+        return jax.jit(apply_step, donate_argnums=(0, 1, 2))
+
+    def _build_train_step_compressed(self):
+        core = self._compressed_apply_core()
+
+        def train_step(params, opt_state, ls_state, batch, rng, step):
+            grads, loss = self._grouped_grads(params, batch, rng, step, 1.0)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            new_params, new_opt = core(params, opt_state, grads)
+            return (new_params, new_opt, ls_state, loss,
+                    jnp.bool_(False), jnp.float32(0.0))
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
     def _build_fwd_bwd(self):
+        if self._compressed_mode is not None:
+            return self._build_fwd_bwd_compressed()
         model = self.module
         gas = self.gradient_accumulation_steps
 
@@ -476,6 +718,8 @@ class DeepSpeedEngine:
         )
 
     def _build_apply(self):
+        if self._compressed_mode is not None:
+            return self._build_apply_compressed()
         tx = self._tx
         clip = self.gradient_clipping
         check_fp16 = self.fp16_enabled
@@ -526,6 +770,8 @@ class DeepSpeedEngine:
         """Fused fwd+bwd+optimizer in ONE compiled program (used by
         train_batch when gas == 1): one dispatch instead of two, and XLA
         overlaps the optimizer update with the tail of the backward."""
+        if self._compressed_mode is not None:
+            return self._build_train_step_compressed()
         model = self.module
         tx = self._tx
         clip = self.gradient_clipping

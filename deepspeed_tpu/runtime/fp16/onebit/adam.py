@@ -25,6 +25,19 @@ import jax.numpy as jnp
 import optax
 
 
+def padded_length(n: int, k: int) -> int:
+    """Length of ``n`` rounded up to a multiple of the axis size ``k``
+    (compressed_allreduce chunks the tensor k ways)."""
+    return -(-n // k) * k
+
+
+def _pad_to(flat, n_pad):
+    n = flat.shape[0]
+    if n == n_pad:
+        return flat
+    return jnp.concatenate([flat, jnp.zeros((n_pad - n,), flat.dtype)])
+
+
 def _compress(x, error):
     """Sign compression with error feedback: returns (signs int8, scale,
     new_error). scale is the mean |corrected| so that scale*sign is the
@@ -106,22 +119,19 @@ def onebit_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
             raise ValueError("pass axis_size (dp world size) so server "
                              "error buffers can be shaped")
 
-        def server_zeros(p):
-            n = p.size
-            if n % k:
-                raise ValueError(
-                    f"param size {n} not divisible by dp world {k}; "
-                    f"pad parameters or exclude from 1-bit adam")
-            return jnp.zeros((n // k,), jnp.float32)
-
         return OnebitAdamState(
             count=jnp.zeros((), jnp.int32),
             exp_avg=zeros,
             exp_avg_sq=jax.tree.map(lambda p: jnp.zeros_like(
                 p, jnp.float32), params),
+            # error buffers are padded so any leaf size works (the exchange
+            # chunks the flat tensor k ways)
             worker_error=jax.tree.map(
-                lambda p: jnp.zeros((p.size,), jnp.float32), params),
-            server_error=jax.tree.map(server_zeros, params),
+                lambda p: jnp.zeros((padded_length(p.size, k),),
+                                    jnp.float32), params),
+            server_error=jax.tree.map(
+                lambda p: jnp.zeros((padded_length(p.size, k) // k,),
+                                    jnp.float32), params),
         )
 
     def update(grads, state, params):
@@ -151,10 +161,11 @@ def onebit_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
             flat_se = jax.tree.leaves(state.server_error)
             out_m, out_we, out_se = [], [], []
             for m, we, se in zip(flat_m, flat_we, flat_se):
-                shape = m.shape
+                shape, n = m.shape, m.size
                 red, we2, se2 = compressed_allreduce(
-                    m.reshape(-1), we, se, axis)
-                out_m.append(red.reshape(shape))
+                    _pad_to(m.reshape(-1).astype(jnp.float32),
+                            we.shape[0]), we, se, axis)
+                out_m.append(red[:n].reshape(shape))
                 out_we.append(we2)
                 out_se.append(se2)
             exp_avg = jax.tree.unflatten(treedef, out_m)
@@ -171,12 +182,15 @@ def onebit_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         bias2 = 1 - b2 ** jnp.maximum(
             jnp.minimum(count, warmup_steps), 1).astype(jnp.float32)
 
+        lr = (learning_rate(count) if callable(learning_rate)
+              else learning_rate)
+
         def step_one(p, m, v):
             denom = jnp.sqrt(v / bias2) + eps
             upd = m / bias1 / denom
             if weight_decay > 0:
                 upd = upd + weight_decay * p
-            return (-learning_rate * upd).astype(p.dtype)
+            return (-lr * upd).astype(p.dtype)
 
         updates = jax.tree.map(step_one, params, exp_avg, exp_avg_sq)
         return updates, OnebitAdamState(

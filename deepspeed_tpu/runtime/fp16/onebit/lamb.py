@@ -22,6 +22,7 @@ import optax
 
 from deepspeed_tpu.runtime.fp16.onebit.adam import (
     OnebitAdamState,
+    _pad_to,
     compressed_allreduce,
     onebit_adam,
 )
@@ -41,6 +42,8 @@ def onebit_lamb(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
 
     def update(grads, state, params):
         raw_updates, state = inner.update(grads, state, params)
+        lr = (learning_rate(state.count) if callable(learning_rate)
+              else learning_rate)
 
         def scale_one(p, u):
             upd = -u  # inner returns the negative step at lr=1
@@ -52,7 +55,7 @@ def onebit_lamb(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
                 (wn > 0) & (un > 0),
                 jnp.clip(wn / jnp.maximum(un, 1e-12), min_trust, max_trust),
                 1.0)
-            return (-learning_rate * trust * upd).astype(p.dtype)
+            return (-lr * trust * upd).astype(p.dtype)
 
         return jax.tree.map(scale_one, params, raw_updates), state
 
@@ -99,9 +102,11 @@ def zero_one_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         flat_se = jax.tree.leaves(state.server_error)
         out_m, out_we, out_se = [], [], []
         for m, we, se in zip(flat_m, flat_we, flat_se):
-            red, we2, se2 = compressed_allreduce(m.reshape(-1), we, se,
-                                                 axis)
-            out_m.append(red.reshape(m.shape))
+            n = m.size
+            red, we2, se2 = compressed_allreduce(
+                _pad_to(m.reshape(-1).astype(jnp.float32), we.shape[0]),
+                we, se, axis)
+            out_m.append(red[:n].reshape(m.shape))
             out_we.append(we2)
             out_se.append(se2)
         exp_avg = jax.tree.unflatten(treedef, out_m)
@@ -120,13 +125,15 @@ def zero_one_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         # v sees one update per refresh (steps 1, P, 2P, ...); count them
         n_refresh = (1 + count // var_update_period).astype(jnp.float32)
         bias2 = 1 - b2 ** n_refresh
+        lr = (learning_rate(count) if callable(learning_rate)
+              else learning_rate)
 
         def step_one(p, m, v):
             denom = jnp.sqrt(v / bias2) + eps
             upd = m / bias1 / denom
             if weight_decay > 0:
                 upd = upd + weight_decay * p
-            return (-learning_rate * upd).astype(p.dtype)
+            return (-lr * upd).astype(p.dtype)
 
         updates = jax.tree.map(step_one, params, exp_avg, exp_avg_sq)
         return updates, ZeroOneAdamState(

@@ -24,17 +24,30 @@ def _normalize_betas(params: Dict[str, Any]):
     return float(betas[0]), float(betas[1])
 
 
+def is_compressed_optimizer(opt_type: Optional[str]) -> bool:
+    """True for the 1-bit family (compressed-communication optimizers)."""
+    return (opt_type or "").lower() in (
+        C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER,
+        C.ONEBIT_LAMB_OPTIMIZER)
+
+
 def build_optimizer(
     opt_type: Optional[str],
     opt_params: Optional[Dict[str, Any]] = None,
     learning_rate: Union[float, Callable, None] = None,
     use_pallas: bool = False,
+    compression_axis: Optional[str] = None,
+    compression_axis_size: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Map a DeepSpeed optimizer block to an optax transformation.
 
     ``learning_rate`` may be a float or a trace-safe schedule fn; when None,
     the lr from the params block is used. ``use_pallas`` routes FusedAdam to
-    the single-pass Pallas kernel.
+    the single-pass Pallas kernel. For the 1-bit family pass
+    ``compression_axis``/``compression_axis_size`` (the data-parallel mesh
+    axis the sign-compressed exchange runs over — the engine does this; the
+    returned transformation must be called inside shard_map with PER-WORKER
+    gradients, see runtime/fp16/onebit).
     """
     opt_params = dict(opt_params or {})
     lr = learning_rate if learning_rate is not None else opt_params.get("lr", 1e-3)
@@ -73,12 +86,47 @@ def build_optimizer(
                          nesterov=bool(opt_params.get("nesterov", False)))
     if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER,
                 C.ONEBIT_LAMB_OPTIMIZER):
-        # Compressed-communication optimizers (reference runtime/fp16/onebit/):
-        # on TPU the grad reduction is XLA's; int8-compressed collectives live
-        # in comm/compressed.py. The inner update rule is Adam/LAMB.
+        # Compressed-communication optimizers (reference runtime/fp16/onebit/
+        # adam.py:10 + runtime/comm/nccl.py:51): sign-compressed momentum
+        # exchange over the data-parallel axis. The engine passes the mesh
+        # axis; without one (standalone build_optimizer call) there is no
+        # axis to exchange over, so fall back to the uncompressed update
+        # rule with a warning.
+        if compression_axis is not None and compression_axis_size is not None:
+            from deepspeed_tpu.runtime.fp16.onebit import (
+                onebit_adam,
+                onebit_lamb,
+                zero_one_adam,
+            )
+
+            # reference OnebitAdam calls the warmup length freeze_step
+            warmup = int(opt_params.get(
+                "freeze_step", opt_params.get("warmup_steps", 100)))
+            if name == C.ONEBIT_LAMB_OPTIMIZER:
+                return onebit_lamb(
+                    lr, b1=b1, b2=b2, eps=eps, weight_decay=wd,
+                    warmup_steps=warmup, axis=compression_axis,
+                    axis_size=compression_axis_size)
+            if name == C.ZERO_ONE_ADAM_OPTIMIZER:
+                if "freeze_step" in opt_params:
+                    logger.warning(
+                        "ZeroOneAdam has no full-precision warmup stage "
+                        "(0/1 Adam compresses from step 1; the variance "
+                        "refresh period governs accuracy) — freeze_step "
+                        "is ignored")
+                return zero_one_adam(
+                    lr, b1=b1, b2=b2, eps=eps, weight_decay=wd,
+                    var_update_period=int(opt_params.get(
+                        "var_update_period", 16)),
+                    axis=compression_axis,
+                    axis_size=compression_axis_size)
+            return onebit_adam(
+                lr, b1=b1, b2=b2, eps=eps, weight_decay=wd,
+                warmup_steps=warmup, axis=compression_axis,
+                axis_size=compression_axis_size)
         logger.warning(
-            "%s: using uncompressed inner optimizer; compressed collectives "
-            "are configured via comms (see comm/compressed.py)", opt_type,
+            "%s: no mesh axis provided; using the uncompressed inner "
+            "optimizer (the engine wires the compressed exchange)", opt_type,
         )
         if "lamb" in name:
             return optax.lamb(lr, b1=b1, b2=b2, eps=eps, weight_decay=wd)
