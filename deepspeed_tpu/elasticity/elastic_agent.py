@@ -76,7 +76,18 @@ class DSElasticAgent:
             chips = world * int(env.get("DS_TPU_CHIPS_PER_PROC", "1"))
             final_bs, _valid, micro = compute_elastic_config(
                 self.ds_config, world_size=chips, return_microbatch=True)
-            gas = max(1, final_bs // (micro * chips))
+            # the solver guarantees divisibility by micro * dp_world where
+            # dp_world = chips / model_parallel_size (elasticity.py
+            # pick_microbatch) — the exported triad must multiply back
+            # exactly, else the effective batch silently shrinks and the
+            # fixed-batch invariant this agent exists to guarantee breaks
+            mp = int(elastic.get("model_parallel_size", 1))
+            dp_world = max(1, chips // mp)
+            if final_bs % (micro * dp_world):
+                raise ElasticAgentError(
+                    f"elastic config is inconsistent: batch {final_bs} is "
+                    f"not divisible by micro*dp_world ({micro}*{dp_world})")
+            gas = final_bs // (micro * dp_world)
             env["DS_TPU_ELASTIC_TRAIN_BATCH"] = str(final_bs)
             env["DS_TPU_ELASTIC_MICRO_BATCH"] = str(micro)
             env["DS_TPU_ELASTIC_GAS"] = str(gas)

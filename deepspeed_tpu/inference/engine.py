@@ -87,8 +87,13 @@ class InferenceEngine:
             self.topology, stage=0, tp_rules=rules)
 
         self._rng = jax.random.PRNGKey(seed)
-        self._params = (None if hf_params is None
-                        else jax.tree.map(jnp.asarray, hf_params))
+        # imported weights stay HOST-side until _materialize device_puts
+        # each leaf with its TP sharding: an eager jnp.asarray would land
+        # the full unsharded model on one chip first (7B fp32 = 28 GB),
+        # OOMing even when tp>1 would fit (same rule as the training
+        # engine's _place_initial_params)
+        self._params = None
+        self._host_params = hf_params
         self._prefill_fn = None
         self._decode_fn = None
         self._fwd_fn = None
@@ -144,15 +149,34 @@ class InferenceEngine:
 
         shapes = jax.eval_shape(init_fn, rng)
         self._param_shardings = self.sharding_rules.param_sharding_tree(shapes)
-        if self._params is None:
+        if self._host_params is not None:
+            # each device receives only its shard; half-precision cast
+            # happens on HOST so full-precision leaves never transit
+            cast = self.dtype if self.dtype in (jnp.float16, jnp.bfloat16) \
+                else None
+
+            def place(leaf, shape_dtype, sharding):
+                arr = np.asarray(leaf)
+                # jnp.issubdtype: ml_dtypes bfloat16 is NOT np.floating
+                if cast is not None and jnp.issubdtype(
+                        arr.dtype, jnp.floating):
+                    arr = arr.astype(cast)
+                if arr.shape != shape_dtype.shape:
+                    raise ValueError(
+                        f"loaded leaf shape {arr.shape} != model shape "
+                        f"{shape_dtype.shape}")
+                return jax.device_put(arr, sharding)
+
+            self._params = jax.tree.map(
+                place, self._host_params, shapes, self._param_shardings)
+            self._host_params = None  # free the host copy
+            if self.dtype == jnp.int8:
+                self._params = self._cast(self._params)
+        else:
+            # no imported/loaded weights: random init, sharded at creation
             self._params = jax.jit(
                 init_fn, out_shardings=self._param_shardings)(rng)
-        else:
-            # re-place loaded params with TP shardings
-            self._params = jax.jit(
-                lambda t: t, out_shardings=self._param_shardings
-            )(self._params)
-        self._params = self._cast(self._params)
+            self._params = self._cast(self._params)
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, **kwargs):
@@ -261,11 +285,11 @@ class InferenceEngine:
         state_dict_factory MP resharding, state_dict_factory.py:20)."""
         state = MsgpackCheckpointEngine().load(path)
         module = state.get("module", state)
-        # concrete arrays; placed/sharded at _materialize
-        self._params = serialization.msgpack_restore(
+        # HOST-side arrays; placed per-shard at _materialize (see __init__)
+        self._host_params = serialization.msgpack_restore(
             serialization.msgpack_serialize(module)) if not isinstance(
                 module, dict) else module
-        self._params = jax.tree.map(jnp.asarray, self._params)
+        self._params = None
 
     @property
     def params(self):

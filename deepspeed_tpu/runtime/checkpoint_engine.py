@@ -102,6 +102,8 @@ class AsyncCheckpointEngine(CheckpointEngine):
     def __init__(self, config_params=None):
         super().__init__(config_params)
         self._queue: "queue.Queue" = queue.Queue()
+        # _errors/_pending cross the writer/caller threads: lock every access
+        self._lock = threading.Lock()
         self._errors: list = []
         self._pending: list = []
         self._worker = threading.Thread(target=self._drain, daemon=True)
@@ -117,14 +119,16 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 _write_atomic(host_state, path)
                 log_dist(f"[ckpt] async saved {path}", ranks=[0])
             except Exception as e:  # surfaced at commit()
-                self._errors.append((path, e))
+                with self._lock:
+                    self._errors.append((path, e))
             finally:
                 done.set()
 
     def save(self, state_dict: Dict[str, Any], path: str):
         host_state = _to_host(state_dict)  # consistent snapshot, blocking
         done = threading.Event()
-        self._pending.append(done)
+        with self._lock:
+            self._pending.append(done)
         self._queue.put((host_state, path, done))
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
@@ -134,16 +138,19 @@ class AsyncCheckpointEngine(CheckpointEngine):
             return serialization.msgpack_restore(f.read())
 
     def wait(self):
-        for done in self._pending:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for done in pending:
             done.wait()
-        self._pending = []
 
     def _raise_errors(self):
-        if self._errors:
-            path, err = self._errors[0]
-            self._errors = []
-            raise RuntimeError(f"async checkpoint write failed for {path}"
-                               ) from err
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            paths = ", ".join(p for p, _ in errors)
+            raise RuntimeError(
+                f"async checkpoint write failed for {len(errors)} "
+                f"file(s): {paths}") from errors[0][1]
 
     def commit(self, tag: str) -> bool:
         self.wait()
