@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""BERT inference latency benchmark (reference
+benchmarks/inference/bert-bench.py: p50/p90 latency over a
+fill-mask-style forward at several batch sizes).
+
+  python benchmarks/inference/bert_bench.py --model bert-large --seq 128
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 3)[0])
+
+
+def run_once(model_name, seq, batch, trials, dtype):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models.bert import BertForPreTraining, bert_config
+
+    cfg = bert_config(model_name, dtype=dtype)
+    model = BertForPreTraining(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(batch, seq)),
+                      jnp.int32)
+    params = jax.jit(
+        lambda r: model.init(r, ids, deterministic=True))(
+            jax.random.PRNGKey(0))
+
+    fwd = jax.jit(lambda p, x: model.apply(p, x, deterministic=True))
+
+    def fence(x):
+        return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
+
+    fence(fwd(params, ids))  # compile
+    lat = []
+    for _ in range(trials):
+        t0 = time.time()
+        fence(fwd(params, ids))
+        lat.append((time.time() - t0) * 1e3)
+    lat = np.array(sorted(lat))
+    return {
+        "batch": batch,
+        "p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "p90_ms": round(float(np.percentile(lat, 90)), 2),
+        "seq_per_sec": round(batch / (np.median(lat) / 1e3), 1),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="bert-large")
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
+    p.add_argument("--trials", type=int, default=10)
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"])
+    args = p.parse_args()
+
+    import json
+
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    for batch in args.batches:
+        r = run_once(args.model, args.seq, batch, args.trials, dtype)
+        r.update({"model": args.model, "seq": args.seq,
+                  "dtype": args.dtype})
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,8 @@ class GPTConfig:
     use_bias: bool = True              # biases on dense + norm layers
     attn_bias: Optional[bool] = None   # override for attention projections
                                        # (GPT-J: biasless attn, biased MLP)
+    alibi: bool = False                # ALiBi attention bias (BLOOM)
+    embed_layernorm: bool = False      # LN right after wte (BLOOM)
     rotary: bool = False               # rotary embeddings (ops/rotary.py)
     rotary_pct: float = 1.0            # fraction of head_dim rotated (NeoX)
     rotary_interleaved: bool = False   # GPT-J even/odd pairing
@@ -63,6 +65,11 @@ class GPTConfig:
     remat_policy: str = "full"
     scan_layers: bool = True
     use_flash_attention: bool = False  # Pallas kernel path (ops/pallas)
+    # ZeRO-Infinity parameter tier (ops/streaming.py): layer-stack params
+    # live in host memory; the scan streams one layer into HBM per step.
+    # Pair with ds_config zero_optimization.offload_param (engine places
+    # the shardings in pinned_host). Requires scan_layers.
+    param_offload: bool = False
     # sequence/context parallelism over the sp mesh axis
     # (parallel/sequence.py): "none" | "ring" | "ulysses"
     sequence_parallel: str = "none"
@@ -91,6 +98,10 @@ class GPTConfig:
             raise ValueError(
                 f"n_head ({self.n_head}) must be divisible by n_kv_head "
                 f"({self.n_kv_head})")
+        if self.param_offload and not self.scan_layers:
+            raise ValueError(
+                "param_offload streams layer slices out of the scan; it "
+                "requires scan_layers=True")
 
     @property
     def head_dim(self) -> int:
@@ -225,6 +236,10 @@ class CausalSelfAttention(nn.Module):
             att = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all) * scale
             q_pos = idx + jnp.arange(T)[:, None]            # [T, 1]
             k_pos = jnp.arange(cfg.n_positions)[None, :]    # [1, max]
+            if cfg.alibi:
+                slopes = jnp.asarray(alibi_slopes(H)).reshape(Hkv, G)
+                att = att + (slopes[:, :, None, None]
+                             * k_pos[None].astype(att.dtype))
             visible = k_pos <= q_pos                        # causal over cache
             att = jnp.where(visible[None, None, None], att,
                             jnp.finfo(att.dtype).min)
@@ -242,7 +257,9 @@ class CausalSelfAttention(nn.Module):
         v = repeat_kv(v)
 
         # like the flash path, sp attention has no attention-prob dropout
+        # (and no ALiBi bias hook)
         if (cfg.sequence_parallel != "none" and mask is None
+                and not cfg.alibi
                 and (cfg.dropout == 0.0 or deterministic)):
             from deepspeed_tpu.parallel.mesh import get_default_topology
             from deepspeed_tpu.parallel.sequence import (
@@ -262,7 +279,7 @@ class CausalSelfAttention(nn.Module):
         # flash path needs 128-aligned seq (TPU tile constraint), no padding
         # mask, and no attention dropout (the kernel has none)
         use_flash = (cfg.use_flash_attention and mask is None
-                     and T % 128 == 0
+                     and T % 128 == 0 and not cfg.alibi
                      and (cfg.dropout == 0.0 or deterministic))
         if use_flash:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
@@ -271,6 +288,13 @@ class CausalSelfAttention(nn.Module):
         else:
             scale = 1.0 / np.sqrt(D)
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            if cfg.alibi:
+                # bias slopes_h * k_pos (HF BLOOM formula; equivalent to
+                # slopes * (k - q) under softmax's row-shift invariance)
+                slopes = jnp.asarray(alibi_slopes(H))
+                att = att + (slopes[None, :, None, None]
+                             * jnp.arange(T, dtype=att.dtype)[None, None,
+                                                              None, :])
             if cfg.causal:
                 tri = jnp.tril(jnp.ones((T, T), dtype=bool))
                 att = jnp.where(tri[None, None, :, :], att,
@@ -356,6 +380,22 @@ class Block(nn.Module):
         return x, l_aux
 
 
+def alibi_slopes(n_head: int) -> np.ndarray:
+    """Per-head ALiBi slopes (BLOOM; HF build_alibi_tensor math exactly,
+    reference BLOOMLayerPolicy replace_policy.py:444 serves these models
+    through its fused kernels)."""
+    import math
+
+    closest = 2 ** math.floor(math.log2(n_head))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** i for i in range(1, closest + 1)]
+    if closest != n_head:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        n_extra = min(closest, n_head - closest)
+        slopes += [extra_base ** i for i in range(1, 2 * n_extra, 2)]
+    return np.asarray(slopes, np.float32)
+
+
 def _remat_policy(name: str):
     import jax
 
@@ -399,6 +439,19 @@ class ScannedBlocks(nn.Module):
             x, l_aux = call_block(block, x, mask)
             return (x, mask), l_aux
 
+        block_cls = Block
+        if cfg.param_offload:
+            # ZeRO-Infinity param tier: the scan's per-iteration slice of
+            # the (host-resident) layer stack is copied into HBM right
+            # before use — one layer's working set in device memory at a
+            # time (ops/streaming.py; reference partition_parameters.py:537
+            # remote_device="cpu" + coordinator fetch_sub_module)
+            from deepspeed_tpu.ops.streaming import stream_tree_to_device
+
+            block_cls = nn.map_variables(
+                Block, "params", trans_in_fn=stream_tree_to_device,
+                init=True)
+
         scanned = nn.scan(
             body,
             variable_axes={"params": 0, "cache": 0},
@@ -406,7 +459,7 @@ class ScannedBlocks(nn.Module):
             length=cfg.n_layer,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), l_aux = scanned(Block(cfg, name="block"), (x, mask))
+        (x, _), l_aux = scanned(block_cls(cfg, name="block"), (x, mask))
         return x, jnp.sum(l_aux)
 
 
@@ -453,6 +506,12 @@ class GPT(nn.Module):
     # engine reads this for TP sharding (runtime/zero/sharding.py)
     tp_rules = staticmethod(gpt_tp_rules)
 
+    def param_offload_filter(self, path: str) -> bool:
+        """Which param leaves the engine may place in host memory: exactly
+        the ones this model streams back per-layer — the scanned stack
+        under ``h`` (runtime/engine.py offload_param)."""
+        return self.config.param_offload and path.startswith("['h']")
+
     @nn.compact
     def __call__(self, input_ids, labels=None, attention_mask=None,
                  deterministic=True, decode=False):
@@ -461,6 +520,8 @@ class GPT(nn.Module):
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wte")
         x = wte(input_ids)
+        if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
+            x = _norm(cfg, "ln_embed")(x)
         if cfg.learned_positions:
             wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="wpe")

@@ -44,7 +44,11 @@ def __getattr__(name):
     # lazily; ``from deepspeed_tpu.module_inject import import_hf_model``
     _hf_api = ("import_hf_model", "is_hf_model", "gpt2_from_hf",
                "bert_from_hf", "gptneox_from_hf", "gptj_from_hf",
-               "opt_from_hf", "llama_from_hf")
+               "opt_from_hf", "llama_from_hf", "mixtral_from_hf",
+               "bloom_from_hf", "megatron_gpt_from_sd",
+               "clip_from_hf", "gpt2_to_hf_state_dict",
+               "gpt2_config_from_hf", "gpt2_params_from_hf",
+               "bert_config_from_hf", "bert_params_from_hf")
     if name in _hf_api:
         from deepspeed_tpu.module_inject import hf
 

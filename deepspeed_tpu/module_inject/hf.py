@@ -39,6 +39,18 @@ def _np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
+def _deinterleave_qkv(w, b, n_head: int, head_dim: int):
+    """Fused per-head-interleaved qkv (rows laid out [H, 3, D] — NeoX,
+    BLOOM, Megatron-LM) -> flax c_attn {kernel [C, 3C] as [q|k|v], bias}."""
+    H, D = n_head, head_dim
+    w = w.reshape(H, 3, D, -1)
+    b = b.reshape(H, 3, D)
+    kernel = np.concatenate(
+        [w[:, j].reshape(H * D, -1) for j in range(3)], axis=0).T
+    bias = np.concatenate([b[:, j].reshape(H * D) for j in range(3)])
+    return {"kernel": kernel, "bias": bias}
+
+
 def _stack(layers):
     """[{path: leaf}, ...] per layer -> one tree stacked on axis 0."""
     import jax
@@ -293,14 +305,9 @@ def gptneox_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
                 "bias": _np(sd[f"{prefix}.bias"])}
 
     def qkv(i):
-        w = _np(sd[f"layers.{i}.attention.query_key_value.weight"])  # [3C, C]
-        b = _np(sd[f"layers.{i}.attention.query_key_value.bias"])    # [3C]
-        w = w.reshape(H, 3, D, -1)  # de-interleave per-head q/k/v rows
-        b = b.reshape(H, 3, D)
-        kernel = np.concatenate(
-            [w[:, j].reshape(H * D, -1) for j in range(3)], axis=0).T
-        bias = np.concatenate([b[:, j].reshape(H * D) for j in range(3)])
-        return {"kernel": kernel, "bias": bias}
+        return _deinterleave_qkv(
+            _np(sd[f"layers.{i}.attention.query_key_value.weight"]),
+            _np(sd[f"layers.{i}.attention.query_key_value.bias"]), H, D)
 
     def linear(prefix):
         return {"kernel": _np(sd[f"{prefix}.weight"]).T,
@@ -800,6 +807,156 @@ def clip_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
 
 
 # ---------------------------------------------------------------------------
+# BLOOM (reference BLOOMLayerPolicy, replace_policy.py:444) — ALiBi position
+# bias, LN on the word embeddings, per-head-interleaved fused qkv
+# ---------------------------------------------------------------------------
+def bloom_from_hf(hf_model, dtype=jnp.bfloat16, **config_overrides):
+    """``transformers.BloomForCausalLM`` -> ``(GPT, params)``."""
+    from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+
+    hc = hf_model.config
+    if getattr(hc, "apply_residual_connection_post_layernorm", False):
+        raise ValueError(
+            "apply_residual_connection_post_layernorm BLOOM variants are "
+            "not supported (pre-LN residual only)")
+    kw = dict(
+        vocab_size=hc.vocab_size,
+        n_positions=int(config_overrides.pop("n_positions", 2048)),
+        n_embd=hc.hidden_size,
+        n_layer=hc.n_layer,
+        n_head=hc.n_head,
+        layer_norm_epsilon=hc.layer_norm_epsilon,
+        activation="gelu_tanh",  # BloomGelu IS the tanh approximation
+        alibi=True,
+        embed_layernorm=True,
+        learned_positions=False,
+        tie_word_embeddings=True,
+        dropout=0.0, dtype=dtype,
+    )
+    kw.update(config_overrides)
+    cfg = GPTConfig(**kw)
+
+    sd = {k.removeprefix("transformer."): v
+          for k, v in hf_model.state_dict().items()}
+    H, D = cfg.n_head, cfg.head_dim
+
+    def ln(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    def qkv(i):
+        # fused [3C, C] with per-head interleave [H, 3, D] on the rows —
+        # the same de-interleave the reference's policy applies before MP
+        # slicing (replace_policy.py:462 attention.query_key_value)
+        return _deinterleave_qkv(
+            _np(sd[f"h.{i}.self_attention.query_key_value.weight"]),
+            _np(sd[f"h.{i}.self_attention.query_key_value.bias"]), H, D)
+
+    def linear(prefix):
+        return {"kernel": _np(sd[f"{prefix}.weight"]).T,
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    def layer(i):
+        p = f"h.{i}"
+        return {
+            "ln_1": ln(f"{p}.input_layernorm"),
+            "ln_2": ln(f"{p}.post_attention_layernorm"),
+            "attn": {"c_attn": qkv(i),
+                     "c_proj": linear(f"{p}.self_attention.dense")},
+            "mlp": {"c_fc": linear(f"{p}.mlp.dense_h_to_4h"),
+                    "c_proj": linear(f"{p}.mlp.dense_4h_to_h")},
+        }
+
+    params = {
+        "wte": {"embedding": _np(sd["word_embeddings.weight"])},
+        "ln_embed": ln("word_embeddings_layernorm"),
+        "ln_f": ln("ln_f"),
+    }
+    _pack_gpt_layers(params, [layer(i) for i in range(cfg.n_layer)],
+                     cfg.scan_layers)
+    return GPT(cfg), params
+
+
+# ---------------------------------------------------------------------------
+# Megatron-LM GPT (reference MegatronLayerPolicy, replace_policy.py:324;
+# checkpoint layout also consumed by state_dict_factory.MegatronSDLoader).
+# Megatron is not an importable dependency here, so the policy converts the
+# CHECKPOINT layout (a state dict) rather than walking live modules.
+# ---------------------------------------------------------------------------
+def megatron_gpt_from_sd(state_dict: Dict[str, Any], n_layer: int,
+                         n_head: int, dtype=jnp.bfloat16,
+                         **config_overrides):
+    """Megatron-LM GPT2Model state dict -> ``(GPT, params)``.
+
+    Accepts both the raw module layout (``language_model.embedding...``)
+    and checkpoint wrappers holding it under ``model``/``module``. The
+    fused qkv rows interleave per head like NeoX (``[H, 3, D]``).
+    """
+    from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+
+    sd = state_dict
+    for wrap in ("model", "module"):
+        if wrap in sd and isinstance(sd[wrap], dict):
+            sd = sd[wrap]
+    flat = {}
+    for k, v in sd.items():
+        flat[k.removeprefix("language_model.")] = v
+    sd = flat
+
+    wte = _np(sd["embedding.word_embeddings.weight"])
+    wpe = _np(sd["embedding.position_embeddings.weight"])
+    n_embd = wte.shape[1]
+    kw = dict(
+        vocab_size=wte.shape[0],
+        n_positions=wpe.shape[0],
+        n_embd=n_embd,
+        n_layer=n_layer,
+        n_head=n_head,
+        activation="gelu_tanh",
+        tie_word_embeddings=True,
+        dropout=0.0, dtype=dtype,
+    )
+    kw.update(config_overrides)
+    cfg = GPTConfig(**kw)
+    H, D = cfg.n_head, cfg.head_dim
+
+    def ln(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]),
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    def qkv(i):
+        return _deinterleave_qkv(
+            _np(sd[f"transformer.layers.{i}.attention.query_key_value"
+                   ".weight"]),
+            _np(sd[f"transformer.layers.{i}.attention.query_key_value"
+                   ".bias"]), H, D)
+
+    def linear(prefix):
+        return {"kernel": _np(sd[f"{prefix}.weight"]).T,
+                "bias": _np(sd[f"{prefix}.bias"])}
+
+    def layer(i):
+        p = f"transformer.layers.{i}"
+        return {
+            "ln_1": ln(f"{p}.input_layernorm"),
+            "ln_2": ln(f"{p}.post_attention_layernorm"),
+            "attn": {"c_attn": qkv(i),
+                     "c_proj": linear(f"{p}.attention.dense")},
+            "mlp": {"c_fc": linear(f"{p}.mlp.dense_h_to_4h"),
+                    "c_proj": linear(f"{p}.mlp.dense_4h_to_h")},
+        }
+
+    params = {
+        "wte": {"embedding": wte},
+        "wpe": {"embedding": wpe},
+        "ln_f": ln("transformer.final_layernorm"),
+    }
+    _pack_gpt_layers(params, [layer(i) for i in range(cfg.n_layer)],
+                     cfg.scan_layers)
+    return GPT(cfg), params
+
+
+# ---------------------------------------------------------------------------
 # dispatch (reference replace_policy.py generic_policies / policy match in
 # replace_module.py:277)
 # ---------------------------------------------------------------------------
@@ -816,6 +973,8 @@ _HF_CONVERTERS = {
     "LlamaForCausalLM": llama_from_hf,
     "MistralForCausalLM": llama_from_hf,
     "MixtralForCausalLM": mixtral_from_hf,
+    "BloomForCausalLM": bloom_from_hf,
+    "BloomModel": bloom_from_hf,  # tied head
     "CLIPModel": clip_from_hf,
 }
 

@@ -264,6 +264,8 @@ class DeepSpeedEngine:
         # ZeRO-Offload (reference zero cpu_offload / ZeRO-Infinity nvme)
         off_cfg = config.zero_config.offload_optimizer or {}
         self._offload_device = off_cfg.get("device", "none")
+        off_param_cfg = config.zero_config.offload_param or {}
+        self._offload_param_device = off_param_cfg.get("device", "none")
         self._offload_opt = None
         self._zero_acc_fn = None
 
@@ -430,6 +432,79 @@ class DeepSpeedEngine:
         return jax.tree.map(place, self._initial_params, param_shapes,
                             self._param_shardings)
 
+    def _apply_param_offload_shardings(self, param_shapes):
+        """ZeRO-Infinity parameter tier (reference
+        partition_parameters.py:537 remote_device="cpu" +
+        partitioned_param_swapper.py:35): rewrite the shardings of the
+        model's streamable leaves to the accelerator host's memory
+        (``pinned_host``). The model's scan streams one layer back into
+        HBM per iteration (ops/streaming.py), so device memory never holds
+        the full parameter set."""
+        if self._offload_param_device != "cpu":
+            raise NotImplementedError(
+                "offload_param device must be 'cpu' (pinned host memory); "
+                f"got {self._offload_param_device!r}")
+        if self._offload_device == "none":
+            raise ValueError(
+                "offload_param requires offload_optimizer: the host "
+                "optimizer step is what writes updated params back to "
+                "host memory (device-resident optimizer state would defeat "
+                "the capacity win)")
+        filt = getattr(self.module, "param_offload_filter", None)
+        if filt is None:
+            raise ValueError(
+                "offload_param needs a model that streams host-resident "
+                "params per layer — expose param_offload_filter(path) and "
+                "stream inside the layer scan (see GPTConfig.param_offload, "
+                "models/transformer_lm.py)")
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        flat, _ = tree_flatten_with_path(param_shapes)
+        marked = [keystr(p) for p, _ in flat if filt(keystr(p))]
+        if not marked:
+            raise ValueError(
+                "offload_param is configured but the model marks no params "
+                "as streamable (is the model's param_offload flag set?)")
+        if self.gradient_accumulation_steps != 1:
+            raise NotImplementedError(
+                "offload_param currently requires "
+                "gradient_accumulation_steps == 1 (host-memory gradient "
+                "accumulation is not implemented); raise the micro batch "
+                "instead")
+        platform = jax.devices()[0].platform
+        if platform != "tpu":
+            log_dist(
+                f"offload_param: backend {platform!r} does not support "
+                "host-memory placement under SPMD; params stay in device "
+                "memory (structure-only mode for tests)", ranks=[0])
+            return
+        threshold = self._config.zero_config.param_persistence_threshold
+        n_off = [0, 0]
+
+        def is_offloaded(path, shape_dtype):
+            return (filt(keystr(path))
+                    and int(np.prod(shape_dtype.shape)) >= threshold)
+
+        def rewrite(path, shape_dtype, sharding):
+            return (sharding.with_memory_kind("pinned_host")
+                    if is_offloaded(path, shape_dtype) else sharding)
+
+        for p, sd in flat:
+            if is_offloaded(p, sd):
+                n_off[0] += 1
+                n_off[1] += int(np.prod(sd.shape))
+        self._param_shardings = jax.tree_util.tree_map_with_path(
+            rewrite, param_shapes, self._param_shardings)
+        # gradients of streamed params assemble in host memory too (the
+        # streaming bwd ships each layer-slice cotangent out as it is
+        # produced) — full grads in HBM would cancel the capacity win
+        self._grad_shardings = jax.tree_util.tree_map_with_path(
+            rewrite, param_shapes, self._grad_shardings)
+        log_dist(
+            f"offload_param: {n_off[0]} leaves / {n_off[1] / 1e6:.0f}M "
+            "params placed in pinned host memory (persistence threshold "
+            f"{threshold})", ranks=[0])
+
     # ------------------------------------------------------------------
     # lazy state init (zero.Init equivalent)
     # ------------------------------------------------------------------
@@ -445,6 +520,8 @@ class DeepSpeedEngine:
         self._param_shardings = self.sharding_rules.param_sharding_tree(param_shapes)
         self._grad_shardings = self.sharding_rules.grad_sharding_tree(param_shapes)
         self._compute_dtype = jax.tree.leaves(param_shapes)[0].dtype
+        if self._offload_param_device != "none":
+            self._apply_param_offload_shardings(param_shapes)
 
         t0 = time.time()
         if self._initial_params is not None:
@@ -689,6 +766,11 @@ class DeepSpeedEngine:
             return self._build_fwd_bwd_compressed()
         model = self.module
         gas = self.gradient_accumulation_steps
+        # offload_param: grads of streamed layers land in HOST memory
+        # (per-layer, from the streaming bwd); elementwise accumulation on
+        # host tensors is not a device op, so the buffer is REPLACED each
+        # micro step (gas == 1 is enforced at init)
+        replace_acc = self._offload_param_device != "none"
 
         def fwd_bwd(params, acc_grads, batch, rng, step, scale):
             # fold the step counter in HERE: a host-side jax.random.split per
@@ -706,6 +788,8 @@ class DeepSpeedEngine:
                 return loss * (scale / gas), loss
 
             grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+            if replace_acc:
+                return grads, loss
             new_acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), acc_grads, grads
             )
@@ -931,12 +1015,15 @@ class DeepSpeedEngine:
         self._params, overflow, _grad_norm = self._offload_opt.step(
             self._acc_grads, loss_scale=scale,
             global_step=self.global_steps, current_params=self._params)
-        if self._zero_acc_fn is None:
-            self._zero_acc_fn = jax.jit(
-                lambda g: jax.tree.map(jnp.zeros_like, g),
-                donate_argnums=(0,),
-                out_shardings=self._grad_shardings)
-        self._acc_grads = self._zero_acc_fn(self._acc_grads)
+        if self._offload_param_device == "none":
+            if self._zero_acc_fn is None:
+                self._zero_acc_fn = jax.jit(
+                    lambda g: jax.tree.map(jnp.zeros_like, g),
+                    donate_argnums=(0,),
+                    out_shardings=self._grad_shardings)
+            self._acc_grads = self._zero_acc_fn(self._acc_grads)
+        # offload_param: the grad tree is REPLACED by the next forward
+        # (host-memory buffers have no device zeroing program)
         if self.fp16_enabled:
             self._ls_state = update_loss_scale(
                 self._ls_state, jnp.bool_(overflow), self._ls_config)

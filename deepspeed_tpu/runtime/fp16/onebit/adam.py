@@ -38,24 +38,37 @@ def _pad_to(flat, n_pad):
     return jnp.concatenate([flat, jnp.zeros((n_pad - n,), flat.dtype)])
 
 
-def _compress(x, error):
+def _compress(x, error, valid_mask=None):
     """Sign compression with error feedback: returns (signs int8, scale,
     new_error). scale is the mean |corrected| so that scale*sign is the
-    l1-optimal 1-bit approximation."""
+    l1-optimal 1-bit approximation. ``valid_mask`` excludes padding slots:
+    pads must not dilute the scale, and their error feedback is pinned to
+    zero so they cannot oscillate into it either."""
     corrected = x + error
-    scale = jnp.mean(jnp.abs(corrected))
+    if valid_mask is not None:
+        corrected = jnp.where(valid_mask, corrected, 0.0)
+        scale = (jnp.sum(jnp.abs(corrected))
+                 / jnp.maximum(jnp.sum(valid_mask), 1))
+    else:
+        scale = jnp.mean(jnp.abs(corrected))
     signs = jnp.where(corrected >= 0, jnp.int8(1), jnp.int8(-1))
     decompressed = scale * signs.astype(x.dtype)
     new_error = corrected - decompressed
+    if valid_mask is not None:
+        new_error = jnp.where(valid_mask, new_error, 0.0)
     return signs, scale, new_error
 
 
-def compressed_allreduce(x, worker_error, server_error, axis: str):
+def compressed_allreduce(x, worker_error, server_error, axis: str,
+                         n_valid: Optional[int] = None):
     """Error-compensated mean-allreduce of ``x`` over mesh axis ``axis``
     (reference NcclBackend.compressed_allreduce, two-phase).
 
     Call inside shard_map. Shapes: x and worker_error [n] (padded to a
-    multiple of the axis size); server_error [n / axis_size].
+    multiple of the axis size); server_error [n / axis_size]. ``n_valid``
+    (static) is the unpadded length: positions >= n_valid are excluded
+    from the compression scales and their error feedback is pinned to 0
+    (pads would otherwise dilute the scale ~k-fold for tiny leaves).
     Returns (allreduced mean, new_worker_error, new_server_error).
 
     The payloads that cross the interconnect are int8 sign tensors plus one
@@ -69,10 +82,12 @@ def compressed_allreduce(x, worker_error, server_error, axis: str):
         raise ValueError(f"tensor length {n} must be divisible by axis "
                          f"size {k}; pad first")
     chunk = n // k
+    padded = n_valid is not None and n_valid < n
     # phase 1: compress locally; ship int8 signs chunk-to-owner via
     # all_to_all (worker j receives every worker's signs for chunk j) and
     # the fp32 scales via a scalar all_gather; sum after decompression.
-    signs, scale, new_worker_error = _compress(x, worker_error)
+    mask1 = (jnp.arange(n) < n_valid) if padded else None
+    signs, scale, new_worker_error = _compress(x, worker_error, mask1)
     signs_by_chunk = signs.reshape(k, chunk)
     recv_signs = jax.lax.all_to_all(signs_by_chunk, axis, split_axis=0,
                                     concat_axis=0, tiled=False)  # [k, chunk]
@@ -81,9 +96,14 @@ def compressed_allreduce(x, worker_error, server_error, axis: str):
         recv_signs.astype(jnp.float32) * scales[:, None], axis=0)
     # phase 2: compress the reduced chunk (mean over workers) with server
     # error feedback; ship int8 signs + fp32 scale, decompress locally.
+    # Pads live only in the tail chunks: mask by this worker's global span.
     server_chunk = chunk_sum / k
+    mask2 = None
+    if padded:
+        j = jax.lax.axis_index(axis)
+        mask2 = (jnp.arange(chunk) + j * chunk) < n_valid
     s_signs, s_scale, new_server_error = _compress(server_chunk,
-                                                   server_error)
+                                                   server_error, mask2)
     all_signs = jax.lax.all_gather(s_signs, axis)          # [k, chunk] int8
     all_scales = jax.lax.all_gather(s_scale, axis)         # [k] fp32
     result = (all_signs.astype(jnp.float32)
@@ -164,7 +184,7 @@ def onebit_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
                 shape, n = m.shape, m.size
                 red, we2, se2 = compressed_allreduce(
                     _pad_to(m.reshape(-1).astype(jnp.float32),
-                            we.shape[0]), we, se, axis)
+                            we.shape[0]), we, se, axis, n_valid=n)
                 out_m.append(red[:n].reshape(shape))
                 out_we.append(we2)
                 out_se.append(se2)

@@ -105,7 +105,7 @@ def zero_one_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
             n = m.size
             red, we2, se2 = compressed_allreduce(
                 _pad_to(m.reshape(-1).astype(jnp.float32), we.shape[0]),
-                we, se, axis)
+                we, se, axis, n_valid=n)
             out_m.append(red[:n].reshape(m.shape))
             out_we.append(we2)
             out_se.append(se2)

@@ -111,9 +111,9 @@ class TestOnebitEngine:
                        "params": {"lr": 5e-2, "freeze_step": 5}}, gas=2)
         it = iter(RepeatingLoader([batch]))
         first = float(eng.train_batch(it))
-        for _ in range(60):
+        for _ in range(99):
             last = float(eng.train_batch(it))
-        assert eng.global_steps == 61
+        assert eng.global_steps == 100
         assert last < 0.2 * first
 
     def test_onebit_lamb_and_zoadam_run(self, eight_devices):
@@ -122,8 +122,10 @@ class TestOnebitEngine:
         for opt in ("OnebitLamb", "ZeroOneAdam"):
             from deepspeed_tpu.parallel import mesh
             mesh.reset_default_topology()
+            # sign-based steps on this ill-conditioned quadratic need a
+            # cool lr (scales are undiluted since the pad-masking fix)
             eng = _engine({"type": opt,
-                           "params": {"lr": 2e-2, "freeze_step": 5}})
+                           "params": {"lr": 5e-3, "freeze_step": 5}})
             it = iter(RepeatingLoader([batch]))
             first = float(eng.train_batch(it))
             for _ in range(80):
@@ -148,9 +150,12 @@ class TestOnebitEngine:
         eng2.train_batch(it2)  # materialize state templates
         eng2.load_checkpoint(str(tmp_path), tag="t")
         assert eng2.global_steps == 10
-        # error-feedback buffers restored (non-zero after compression steps)
-        we = np.asarray(jax.tree.leaves(eng2._opt_state.worker_error)[0])
-        assert np.abs(we).max() > 0
+        # error-feedback buffers restored (non-zero after compression
+        # steps; single-element leaves compress exactly, so check ALL)
+        we = np.concatenate([
+            np.abs(np.asarray(x)).ravel()
+            for x in jax.tree.leaves(eng2._opt_state.worker_error)])
+        assert we.max() > 0
 
     def test_rejects_fp16_and_zero2_and_tp(self, eight_devices):
         with pytest.raises(ValueError, match="fp16"):

@@ -121,13 +121,22 @@ def _tiny_llama():
     return transformers.LlamaForCausalLM(cfg).eval()
 
 
+def _tiny_bloom():
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(6)
+    return transformers.BloomForCausalLM(cfg).eval()
+
+
 @pytest.mark.parametrize("maker,vocab", [
     (_tiny_gptneox, 128),
     (lambda: _tiny_gptneox(parallel=False), 128),
     (_tiny_gptj, 128),
     (_tiny_opt, 128),
     (_tiny_llama, 128),
-], ids=["gptneox", "gptneox-seq", "gptj", "opt", "llama"])
+    (_tiny_bloom, 128),
+], ids=["gptneox", "gptneox-seq", "gptj", "opt", "llama", "bloom"])
 def test_family_logit_parity(maker, vocab):
     """Rotary / parallel-residual / RMSNorm-SwiGLU-GQA / relu-OPT variants
     of the block all match the HF forward after policy conversion."""
@@ -141,6 +150,96 @@ def test_family_logit_parity(maker, vocab):
     model, params = import_hf_model(hf, dtype=jnp.float32)
     got = np.asarray(model.apply({"params": params}, jnp.asarray(ids),
                                  deterministic=True))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_bloom_decode_parity():
+    """ALiBi bias composes with the KV-cache decode path: prefill + decode
+    logits match the full-context forward (cache created by the first
+    mutable apply, as the inference engine does)."""
+    from deepspeed_tpu.module_inject.hf import import_hf_model
+
+    hf = _tiny_bloom()
+    model, params = import_hf_model(hf, dtype=jnp.float32,
+                                    n_positions=32)
+    ids = np.random.RandomState(11).randint(0, 128, size=(1, 8))
+    full = np.asarray(model.apply({"params": params}, jnp.asarray(ids),
+                                  deterministic=True))
+
+    # prefill the first 4 tokens in one chunk, then decode one at a time
+    logits, mut = model.apply(
+        {"params": params}, jnp.asarray(ids[:, :4]), deterministic=True,
+        decode=True, mutable=["cache"])
+    outs = [np.asarray(logits)]
+    cache = mut["cache"]
+    for t in range(4, ids.shape[1]):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, jnp.asarray(ids[:, t:t + 1]),
+            deterministic=True, decode=True, mutable=["cache"])
+        cache = mut["cache"]
+        outs.append(np.asarray(logits))
+    np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_megatron_state_dict_parity():
+    """Megatron-LM GPT checkpoint layout (MegatronLayerPolicy counterpart):
+    a megatron sd assembled from an HF GPT-2's weights (qkv re-interleaved
+    per head) converts back to logit parity with the HF model."""
+    from deepspeed_tpu.module_inject.hf import megatron_gpt_from_sd
+
+    H, D, L, C = 4, 8, 2, 32
+    cfg = transformers.GPT2Config(
+        n_embd=C, n_layer=L, n_head=H, n_positions=64, vocab_size=128,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(8)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+
+    def meg_qkv_w(w):  # HF [C, 3C] -> megatron [3C, C] head-interleaved
+        q, k, v = np.split(w.T, 3, axis=0)
+        return np.stack([t.reshape(H, D, C) for t in (q, k, v)],
+                        axis=1).reshape(3 * C, C)
+
+    def meg_qkv_b(b):
+        q, k, v = np.split(b, 3)
+        return np.stack([t.reshape(H, D) for t in (q, k, v)],
+                        axis=1).reshape(3 * C)
+
+    meg = {
+        "embedding.word_embeddings.weight": sd["transformer.wte.weight"],
+        "embedding.position_embeddings.weight":
+            sd["transformer.wpe.weight"],
+        "transformer.final_layernorm.weight": sd["transformer.ln_f.weight"],
+        "transformer.final_layernorm.bias": sd["transformer.ln_f.bias"],
+    }
+    for i in range(L):
+        p, m = f"transformer.h.{i}", f"transformer.layers.{i}"
+        meg[f"{m}.input_layernorm.weight"] = sd[f"{p}.ln_1.weight"]
+        meg[f"{m}.input_layernorm.bias"] = sd[f"{p}.ln_1.bias"]
+        meg[f"{m}.post_attention_layernorm.weight"] = sd[f"{p}.ln_2.weight"]
+        meg[f"{m}.post_attention_layernorm.bias"] = sd[f"{p}.ln_2.bias"]
+        meg[f"{m}.attention.query_key_value.weight"] = meg_qkv_w(
+            sd[f"{p}.attn.c_attn.weight"])
+        meg[f"{m}.attention.query_key_value.bias"] = meg_qkv_b(
+            sd[f"{p}.attn.c_attn.bias"])
+        meg[f"{m}.attention.dense.weight"] = sd[f"{p}.attn.c_proj.weight"].T
+        meg[f"{m}.attention.dense.bias"] = sd[f"{p}.attn.c_proj.bias"]
+        meg[f"{m}.mlp.dense_h_to_4h.weight"] = sd[f"{p}.mlp.c_fc.weight"].T
+        meg[f"{m}.mlp.dense_h_to_4h.bias"] = sd[f"{p}.mlp.c_fc.bias"]
+        meg[f"{m}.mlp.dense_4h_to_h.weight"] = \
+            sd[f"{p}.mlp.c_proj.weight"].T
+        meg[f"{m}.mlp.dense_4h_to_h.bias"] = sd[f"{p}.mlp.c_proj.bias"]
+
+    # the converter unwraps checkpoint nesting + language_model prefix
+    wrapped = {"model": {f"language_model.{k}": v for k, v in meg.items()}}
+    model, params = megatron_gpt_from_sd(wrapped, n_layer=L, n_head=H,
+                                         dtype=jnp.float32)
+    ids = np.random.RandomState(9).randint(0, 128, size=(2, 12))
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(ids),
+                                 deterministic=True))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
     np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
 
 
