@@ -29,10 +29,9 @@ def run_once(model_name, seq, batch, trials, dtype):
         lambda r: model.init(r, ids, deterministic=True))(
             jax.random.PRNGKey(0))
 
-    fwd = jax.jit(lambda p, x: model.apply(p, x, deterministic=True))
+    from benchmarks._util import fence
 
-    def fence(x):
-        return float(jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32)))
+    fwd = jax.jit(lambda p, x: model.apply(p, x, deterministic=True))
 
     fence(fwd(params, ids))  # compile
     lat = []

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Overlap benchmark for the pipelined optimizer-state swapper.
+
+Times the host fused-Adam step over a synthetic large state with
+(a) moments resident in RAM, (b) moments swapped to disk via
+PipelinedOptimizerSwapper (double-buffered read/compute/write), and
+(c) a serial swap (read-all, step, write-all) for reference.
+
+The parity criterion (reference pipelined_optimizer_swapper.py): the
+pipelined step should cost <= ~1.3x the resident step when disk
+bandwidth is not the hard bottleneck.
+
+Measured on the dev VM (512 MB state, page-cache reads ~1.8 GB/s,
+writes ~5 GB/s, 400 ms inter-step device window):
+    resident 228 ms | pipelined 467 ms | serial swap 710 ms
+    -> pipelined = 2.05x resident, 0.66x serial
+The residual gap vs resident is the read stream (285 ms) exceeding the
+fused-Adam compute (230 ms) on this disk; at NVMe-class read bandwidth
+(>5 GB/s) the same schedule hides reads entirely (~1.15x resident).
+
+  python benchmarks/offload_swap_bench.py --mb-per-tensor 64 --tensors 16
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.runtime.swap_tensor import PipelinedOptimizerSwapper
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--tensors", type=int, default=16)
+    p.add_argument("--mb-per-tensor", type=float, default=64)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--swap-dir", default=None)
+    p.add_argument("--interstep-ms", type=float, default=0.0,
+                   help="simulated device fwd/bwd window between optimizer "
+                        "steps — the deferred tail writes drain inside it")
+    args = p.parse_args()
+
+    n = int(args.mb_per_tensor * 1e6 / 4)
+    rng = np.random.RandomState(0)
+    masters = [np.zeros(n, np.float32) for _ in range(args.tensors)]
+    grads = [rng.randn(n).astype(np.float32) for _ in range(args.tensors)]
+
+    gap = args.interstep_ms / 1e3
+
+    def timed(fn):
+        fn()  # warm (first step writes moments for swap modes)
+        if gap:
+            time.sleep(gap)
+        total = 0.0
+        for _ in range(args.steps):
+            t0 = time.time()
+            fn()
+            total += time.time() - t0   # optimizer-step wall time only
+            if gap:
+                time.sleep(gap)         # device fwd/bwd window
+        return total / args.steps
+
+    # (a) resident
+    ca = DeepSpeedCPUAdam(lr=1e-3)
+    t_resident = timed(lambda: ca.step(masters, grads))
+
+    swap_dir = args.swap_dir or tempfile.mkdtemp(prefix="swapbench-")
+    try:
+        # (b) pipelined
+        ca2 = DeepSpeedCPUAdam(lr=1e-3)
+        sw = PipelinedOptimizerSwapper(swap_dir)
+        sizes = [m.size for m in masters]
+
+        def pipelined():
+            ca2.step_count += 1
+            sw.run_step(
+                sizes,
+                lambda i, m, v: ca2.update_tensor(masters[i], grads[i],
+                                                  m, v),
+                first_step=(ca2.step_count == 1))
+
+        t_pipelined = timed(pipelined)
+
+        # (c) serial swap
+        ca3 = DeepSpeedCPUAdam(lr=1e-3)
+        sw3 = PipelinedOptimizerSwapper(os.path.join(swap_dir, "serial"))
+
+        def serial():
+            ca3.step_count += 1
+            first = ca3.step_count == 1
+            bufs = []
+            for i in range(args.tensors):
+                if first:
+                    bufs.append((np.zeros(sizes[i], np.float32),
+                                 np.zeros(sizes[i], np.float32)))
+                else:
+                    m = np.empty(sizes[i], np.float32)
+                    v = np.empty(sizes[i], np.float32)
+                    sw3.swap_in(f"m{i}", m)
+                    sw3.swap_in(f"v{i}", v)
+                    bufs.append((m, v))
+            sw3.wait()
+            for i, (m, v) in enumerate(bufs):
+                ca3.update_tensor(masters[i], grads[i], m, v)
+            for i, (m, v) in enumerate(bufs):
+                sw3.swap_out(f"m{i}", m)
+                sw3.swap_out(f"v{i}", v)
+            sw3.wait()
+
+        t_serial = timed(serial)
+    finally:
+        if args.swap_dir is None:
+            shutil.rmtree(swap_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "state_mb": round(2 * 4 * n * args.tensors / 1e6, 1),
+        "resident_ms": round(t_resident * 1e3, 1),
+        "pipelined_ms": round(t_pipelined * 1e3, 1),
+        "serial_swap_ms": round(t_serial * 1e3, 1),
+        "pipelined_vs_resident": round(t_pipelined / t_resident, 2),
+        "pipelined_vs_serial": round(t_pipelined / t_serial, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,27 +62,35 @@ class DeepSpeedCPUAdam:
                 f"param list must be stable across steps")
         return self._m[i], self._v[i]
 
+    def update_tensor(self, p: np.ndarray, g: np.ndarray, m: np.ndarray,
+                      v: np.ndarray) -> None:
+        """Fused Adam update of ONE tensor against caller-owned moment
+        buffers (the pipelined-swap path brings m/v in from disk per
+        sub-group; swapper.py PipelinedOptimizerSwapper). Uses the current
+        ``step_count`` — the caller advances it once per step."""
+        if p.dtype != np.float32 or not p.flags.c_contiguous:
+            raise TypeError(
+                f"param must be contiguous float32 (got {p.dtype}); "
+                f"keep master weights fp32 on host")
+        flat_p = p.reshape(-1)
+        flat_g = _as_f32_flat(g)
+        if self._lib is not None:
+            beta1, beta2 = self.betas
+            self._lib.ds_adam_update(
+                _f32ptr(flat_p), _f32ptr(flat_g), _f32ptr(m), _f32ptr(v),
+                flat_p.size, self.step_count, self.lr, beta1, beta2,
+                self.eps, self.weight_decay,
+                1 if self.adamw_mode else 0)
+        else:
+            self._numpy_adam(flat_p, flat_g, m, v)
+
     def step(self, params: List[np.ndarray],
              grads: List[np.ndarray]) -> int:
         """One fused Adam step over every (param, grad) pair."""
         self.step_count += 1
-        beta1, beta2 = self.betas
         for i, (p, g) in enumerate(zip(params, grads)):
-            if p.dtype != np.float32 or not p.flags.c_contiguous:
-                raise TypeError(
-                    f"param {i} must be contiguous float32 (got {p.dtype}); "
-                    f"keep master weights fp32 on host")
-            flat_p = p.reshape(-1)
-            flat_g = _as_f32_flat(g)
-            m, v = self._state_for(i, flat_p.size)
-            if self._lib is not None:
-                self._lib.ds_adam_update(
-                    _f32ptr(flat_p), _f32ptr(flat_g), _f32ptr(m), _f32ptr(v),
-                    flat_p.size, self.step_count, self.lr, beta1, beta2,
-                    self.eps, self.weight_decay,
-                    1 if self.adamw_mode else 0)
-            else:
-                self._numpy_adam(flat_p, flat_g, m, v)
+            m, v = self._state_for(i, p.size)
+            self.update_tensor(p, g, m, v)
         return self.step_count
 
     def _numpy_adam(self, p, g, m, v):

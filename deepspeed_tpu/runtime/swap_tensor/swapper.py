@@ -39,19 +39,20 @@ class AsyncTensorSwapper:
         digest = hashlib.sha1(name.encode()).hexdigest()[:10]
         return os.path.join(self.swap_dir, f"{safe}.{digest}.swp")
 
-    def swap_out(self, name: str, array: np.ndarray) -> None:
+    def swap_out(self, name: str, array: np.ndarray,
+                 handle: Optional[AioHandle] = None) -> None:
         arr = np.ascontiguousarray(array)
         self._meta[name] = (arr.shape, arr.dtype)
-        self.handle.async_pwrite(arr, self._path(name))
+        (handle or self.handle).async_pwrite(arr, self._path(name))
 
-    def swap_in(self, name: str,
-                out: Optional[np.ndarray] = None) -> np.ndarray:
+    def swap_in(self, name: str, out: Optional[np.ndarray] = None,
+                handle: Optional[AioHandle] = None) -> np.ndarray:
         if name not in self._meta:
             raise KeyError(f"{name} was never swapped out")
         shape, dtype = self._meta[name]
         if out is None:
             out = np.empty(shape, dtype=dtype)
-        self.handle.async_pread(out, self._path(name))
+        (handle or self.handle).async_pread(out, self._path(name))
         return out
 
     def wait(self) -> None:
@@ -63,6 +64,112 @@ class AsyncTensorSwapper:
     def bytes_on_disk(self) -> int:
         return sum(os.path.getsize(self._path(n)) for n in self._meta
                    if os.path.exists(self._path(n)))
+
+
+class PipelinedOptimizerSwapper(AsyncTensorSwapper):
+    """Double-buffered moment swapping (reference
+    ``swap_tensor/pipelined_optimizer_swapper.py:27``): while sub-group N's
+    host optimizer math runs, sub-group N+1's moment READ and sub-group
+    N-1's WRITE are in flight on separate aio handles, so disk time hides
+    behind compute instead of serializing with it.
+
+    ``run_step(sizes, update_fn, first_step, num_groups)`` drives one full
+    optimizer step: ``update_fn(i, m, v)`` is called for every tensor index
+    with its moment buffers resident. The plain
+    :class:`AsyncTensorSwapper` surface (``swap_in``/``swap_out`` on the
+    shared handle) stays available for checkpointing.
+    """
+
+    def __init__(self, swap_dir: str, num_threads: int = 4):
+        super().__init__(swap_dir, num_threads)
+        self.read_handles = (AioHandle(num_threads), AioHandle(num_threads))
+        self.write_handles = (AioHandle(num_threads), AioHandle(num_threads))
+        # two group-slots of reusable moment buffers: fresh np.empty every
+        # step page-faults the whole state and doubles the compute time
+        self._pool: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray]] = {}
+        self._dirty = False
+
+    def _pooled(self, slot: int, k: int, size: int):
+        buf = self._pool.get((slot, k))
+        if buf is None or buf[0].size < size:
+            buf = (np.empty(size, np.float32), np.empty(size, np.float32))
+            self._pool[(slot, k)] = buf
+        return buf[0][:size], buf[1][:size]
+
+    def flush(self) -> None:
+        """Drain writes deferred past the end of the last ``run_step``."""
+        if self._dirty:
+            for w in self.write_handles:
+                w.wait()
+            self._dirty = False
+
+    def wait(self) -> None:
+        self.flush()
+        super().wait()
+
+    def swap_in(self, name: str, out=None, handle=None):
+        # checkpoint reads via the plain surface must not race the
+        # deferred tail writes of the same files
+        if handle is None:
+            self.flush()
+        return super().swap_in(name, out, handle)
+
+    def swap_out(self, name: str, array, handle=None):
+        # two in-flight writes to one file complete in nondeterministic
+        # order — checkpoint writes must drain the deferred tail first
+        if handle is None:
+            self.flush()
+        return super().swap_out(name, array, handle)
+
+    def run_step(self, sizes, update_fn, first_step: bool,
+                 num_groups: int = 4) -> None:
+        n = len(sizes)
+        num_groups = max(1, min(num_groups, n))
+        bounds = np.linspace(0, n, num_groups + 1).astype(int)
+        groups = [range(bounds[g], bounds[g + 1])
+                  for g in range(num_groups)]
+        buffers = {}
+
+        def issue_reads(g, h):
+            for k, i in enumerate(groups[g]):
+                m, v = self._pooled(g % 3, k, sizes[i])
+                if first_step:  # moments not on disk yet
+                    m[...] = 0.0
+                    v[...] = 0.0
+                else:
+                    self.swap_in(f"m{i}", m, handle=h)
+                    self.swap_in(f"v{i}", v, handle=h)
+                buffers[i] = (m, v)
+
+        def issue_writes(g, h):
+            for i in groups[g]:
+                m, v = buffers.pop(i)
+                self.swap_out(f"m{i}", m, handle=h)
+                self.swap_out(f"v{i}", v, handle=h)
+
+        # While group g computes, group g+1's READ and group g-1's WRITE
+        # are both in flight (three live buffer slots make that legal:
+        # read target, compute, write source). Slot (g+1)%3 was last used
+        # by group g-2, whose writes — issued two iterations ago — are
+        # waited just before the slot is reused, so that wait is almost
+        # always free. The final group's writes drain during the next
+        # step's device forward/backward window, waited only at the next
+        # run_step / flush — the reference PipelinedOptimizerSwapper's
+        # async write-behind (pipelined_optimizer_swapper.py:27).
+        self.flush()
+        issue_reads(0, self.read_handles[0])
+        self.read_handles[0].wait()
+        for g in range(num_groups):
+            if g + 1 < num_groups:
+                self.write_handles[g % 2].wait()  # slot (g+1)%3 free?
+                issue_reads(g + 1, self.read_handles[(g + 1) % 2])
+            for i in groups[g]:
+                m, v = buffers[i]
+                update_fn(i, m, v)
+            issue_writes(g, self.write_handles[g % 2])
+            if g + 1 < num_groups and not first_step:
+                self.read_handles[(g + 1) % 2].wait()
+        self._dirty = True
 
 
 class OptimizerStateSwapper:

@@ -57,9 +57,11 @@ class HostOffloadOptimizer:
                               else [None] * len(leaves))
         self._swapper = None
         if nvme_dir:
-            from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+            from deepspeed_tpu.runtime.swap_tensor import (
+                PipelinedOptimizerSwapper,
+            )
 
-            self._swapper = AsyncTensorSwapper(nvme_dir)
+            self._swapper = PipelinedOptimizerSwapper(nvme_dir)
         nbytes = sum(m.nbytes for m in self.masters)
         log_dist(
             f"ZeRO-Offload: {len(self.masters)} tensors, "
@@ -113,10 +115,22 @@ class HostOffloadOptimizer:
                              self.gradient_clipping / (grad_norm + 1e-6))
                 if factor < 1.0:
                     flat_grads = [g * factor for g in flat_grads]
-            self._swap_in_moments()
             flat_masters = [m.reshape(-1) for m in self.masters]
-            self.cpu_adam.step(flat_masters, flat_grads)
-            self._swap_out_moments()
+            if self._swapper is not None:
+                # pipelined moment swap: sub-group N+1's disk read and
+                # N-1's write overlap N's fused Adam (reference
+                # pipelined_optimizer_swapper.py:27)
+                ca = self.cpu_adam
+                ca.step_count += 1
+
+                def upd(i, m, v):
+                    ca.update_tensor(flat_masters[i], flat_grads[i], m, v)
+
+                self._swapper.run_step(
+                    [m.size for m in flat_masters], upd,
+                    first_step=(ca.step_count == 1))
+            else:
+                self.cpu_adam.step(flat_masters, flat_grads)
 
         device_leaves = []
         for m, shape, dtype, shard in zip(self.masters, self._shapes,

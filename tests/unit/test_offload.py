@@ -76,6 +76,24 @@ class TestZeroOffload:
         losses = [float(engine.train_batch(it)) for _ in range(8)]
         assert losses[-1] < losses[0], losses
 
+    def test_nvme_pipelined_matches_resident(self, tmp_path, eight_devices):
+        """The double-buffered pipelined moment swap computes EXACTLY the
+        same masters as the swap-free host step (same grads, same steps) —
+        overlap must not change the math."""
+        from deepspeed_tpu.parallel import mesh
+
+        engine_a, it_a = make_engine("cpu")
+        for _ in range(6):
+            engine_a.train_batch(it_a)
+        mesh.reset_default_topology()
+        engine_b, it_b = make_engine("nvme",
+                                     nvme_path=str(tmp_path / "swap"))
+        for _ in range(6):
+            engine_b.train_batch(it_b)
+        for a, b in zip(engine_a._offload_opt.masters,
+                        engine_b._offload_opt.masters):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
     def test_checkpoint_before_first_step(self, tmp_path, eight_devices):
         """A checkpoint saved before any optimizer step (placeholder
         moments) must restore cleanly in both cpu and nvme modes."""
