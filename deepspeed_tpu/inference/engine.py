@@ -215,12 +215,12 @@ class InferenceEngine:
         buffer is donated so decode steps update KV in place."""
         model = self.module
 
-        def prefill(params, ids):
+        def prefill(params, ids, mask):
             # cache variables are created on first mutable apply; the whole
             # prompt is written into the KV cache in one pass
             logits, vars_out = model.apply(
-                {"params": params}, ids, deterministic=True, decode=True,
-                mutable=["cache"])
+                {"params": params}, ids, attention_mask=mask,
+                deterministic=True, decode=True, mutable=["cache"])
             return logits[:, -1], vars_out["cache"]
 
         def step(params, token, cache, rng, temperature):
@@ -242,9 +242,38 @@ class InferenceEngine:
         self._decode_fn = jax.jit(step, donate_argnums=(2,))
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0):
-        """Greedy (temperature=0) or sampled generation."""
+                 temperature: float = 0.0, attention_mask=None):
+        """Greedy (temperature=0) or sampled generation.
+
+        Ragged batches: pass ``attention_mask`` (1 = real token). Prompts
+        are LEFT-aligned internally (pads moved to the front) so valid
+        tokens stay physically contiguous in the KV cache — the masked
+        decode then matches per-sequence generation exactly (reference
+        inference_context.h masked decode; the padding-mask-aware cache
+        lives in models/transformer_lm.py's decode attention).
+        """
         input_ids = jnp.asarray(input_ids)
+        if attention_mask is not None:
+            ids_np = np.asarray(input_ids)
+            m_np = np.asarray(attention_mask).astype(bool)
+            if m_np.shape != ids_np.shape:
+                raise ValueError(
+                    f"attention_mask shape {m_np.shape} != input_ids "
+                    f"shape {ids_np.shape}")
+            if not m_np.any(axis=1).all():
+                empty = np.where(~m_np.any(axis=1))[0].tolist()
+                raise ValueError(
+                    f"attention_mask rows {empty} have no valid tokens; "
+                    "an empty prompt cannot seed generation")
+            T = ids_np.shape[1]
+            out_ids = np.zeros_like(ids_np)
+            out_m = np.zeros_like(m_np)
+            for b in range(ids_np.shape[0]):
+                vtok = ids_np[b][m_np[b]]
+                out_ids[b, T - len(vtok):] = vtok
+                out_m[b, T - len(vtok):] = True
+            input_ids = jnp.asarray(out_ids)
+            attention_mask = jnp.asarray(out_m)
         if max_new_tokens < 0:
             raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
         if max_new_tokens == 0:
@@ -262,7 +291,10 @@ class InferenceEngine:
             self._build_decode_fns()
         self._rng, rng = jax.random.split(self._rng)
 
-        logits_last, cache = self._prefill_fn(self._params, input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones(input_ids.shape, jnp.bool_)
+        logits_last, cache = self._prefill_fn(self._params, input_ids,
+                                              attention_mask)
         rng, sub = jax.random.split(rng)
         if temperature > 0:
             tok = jax.random.categorical(

@@ -198,18 +198,22 @@ class CausalSelfAttention(nn.Module):
             # KV-cache append + attend (the reference's softmax_context
             # kernel with its inference_context.h cache management,
             # csrc/transformer/inference/). Chunk-aware: prefill writes T
-            # tokens at once, decode steps write one.
-            if mask is not None:
-                raise NotImplementedError(
-                    "decode attention is position-masked only; batched "
-                    "generation with padding masks is not supported — "
-                    "left-trim prompts to equal length instead")
+            # tokens at once, decode steps write one. Ragged batches:
+            # LEFT-padded prompts pass ``mask``, and a per-slot validity
+            # cache excludes pad slots from every later step's attention
+            # (reference inference_context.h masked decode). Left padding
+            # keeps valid keys physically contiguous, so rotary (relative
+            # offsets) and ALiBi (row-constant shift under softmax) stay
+            # exact without per-sequence position bookkeeping here.
             cached_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
                 (B, cfg.n_positions, Hkv, D), cfg.dtype)
             cached_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
                 (B, cfg.n_positions, Hkv, D), cfg.dtype)
+            cache_valid = self.variable(
+                "cache", "valid", jnp.zeros,
+                (B, cfg.n_positions), jnp.bool_)
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32))
@@ -224,6 +228,10 @@ class CausalSelfAttention(nn.Module):
                 cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
             cached_v.value = jax.lax.dynamic_update_slice(
                 cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            write_valid = (mask.astype(jnp.bool_) if mask is not None
+                           else jnp.ones((B, T), jnp.bool_))
+            cache_valid.value = jax.lax.dynamic_update_slice(
+                cache_valid.value, write_valid, (0, idx))
             cache_index.value = idx + T
             k_all, v_all = cached_k.value, cached_v.value
 
@@ -241,8 +249,9 @@ class CausalSelfAttention(nn.Module):
                 att = att + (slopes[:, :, None, None]
                              * k_pos[None].astype(att.dtype))
             visible = k_pos <= q_pos                        # causal over cache
-            att = jnp.where(visible[None, None, None], att,
-                            jnp.finfo(att.dtype).min)
+            visible = (visible[None, None, None]            # [1,1,1,T,max]
+                       & cache_valid.value[:, None, None, None, :])
+            att = jnp.where(visible, att, jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(
                 cfg.dtype)
             y = jnp.einsum("bhgqk,bkhd->bqhgd", att, v_all)
@@ -526,11 +535,20 @@ class GPT(nn.Module):
             wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="wpe")
             if decode:
-                # position offset tracked alongside the per-layer KV caches
+                # per-sequence position counters tracked alongside the
+                # per-layer KV caches: with LEFT-padded ragged prompts the
+                # learned position of a token is its count of valid
+                # predecessors, not its physical cache slot
                 position = self.variable("cache", "position",
-                                         lambda: jnp.zeros((), jnp.int32))
-                pos = position.value + jnp.arange(T)[None, :]
-                position.value = position.value + T
+                                         lambda: jnp.zeros((B,), jnp.int32))
+                if attention_mask is not None:
+                    am = attention_mask.astype(jnp.int32)
+                    offs = jnp.clip(jnp.cumsum(am, axis=1) - 1, 0)
+                    pos = position.value[:, None] + offs
+                    position.value = position.value + jnp.sum(am, axis=1)
+                else:
+                    pos = position.value[:, None] + jnp.arange(T)[None, :]
+                    position.value = position.value + T
             else:
                 pos = jnp.arange(T)[None, :]
             x = x + wpe(pos)
