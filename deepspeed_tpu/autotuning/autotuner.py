@@ -41,6 +41,26 @@ class AutotuningConfig:
             "num_tuning_micro_batch_sizes", 3)
         self.zero_stages = d.get("zero_stages", list(DEFAULT_ZERO_STAGES))
         self.mp_size = d.get("mp_size", 1)
+        # TPU extension dimensions (absent -> dimension collapsed, base
+        # config untouched): the knobs that actually move throughput on
+        # TPU are remat policy, the tensor-parallel degree, and optimizer
+        # offload — not just stage x micro-batch
+        self.tp_sizes = d.get("tp_sizes", None)
+        self.remat_policies = d.get("remat_policies", None)
+        self.offload_devices = d.get("offload_devices", None)
+        if self.remat_policies is not None:
+            bad = set(self.remat_policies) - {"none", "selective", "full"}
+            if bad:
+                raise ValueError(f"unknown remat policies {sorted(bad)}")
+        if self.offload_devices is not None:
+            bad = set(self.offload_devices) - {"none", "cpu", "nvme"}
+            if bad:
+                raise ValueError(f"unknown offload devices {sorted(bad)}")
+        if self.tp_sizes is not None:
+            if not all(isinstance(t, int) and t >= 1
+                       for t in self.tp_sizes):
+                raise ValueError(
+                    f"tp_sizes must be positive ints, got {self.tp_sizes}")
         if self.metric not in ("throughput", "latency", "flops"):
             raise ValueError(f"unknown autotuning metric {self.metric!r}")
         if self.tuner_type not in ("gridsearch", "random", "model_based"):
@@ -85,10 +105,21 @@ class Autotuner:
         idx = [last - round(i * last / max(n - 1, 1))
                for i in range(n)] if n > 1 else [last]
         mbs = sorted({candidates[i] for i in idx})
+        # optional TPU dimensions multiply in only when configured
+        extra_dims = []
+        for key, values in (("tp_size", self.cfg.tp_sizes),
+                            ("remat_policy", self.cfg.remat_policies),
+                            ("offload_device", self.cfg.offload_devices)):
+            if values:
+                extra_dims.append([(key, v) for v in values])
         exps = []
-        for stage, mb in itertools.product(self.cfg.zero_stages, mbs):
-            exps.append({"zero_stage": stage,
-                         "train_micro_batch_size_per_gpu": mb})
+        for combo in itertools.product(self.cfg.zero_stages, mbs,
+                                       *extra_dims):
+            stage, mb = combo[0], combo[1]
+            exp = {"zero_stage": stage,
+                   "train_micro_batch_size_per_gpu": mb}
+            exp.update(dict(combo[2:]))
+            exps.append(exp)
         return exps
 
     def exp_to_config(self, exp: Dict[str, Any]) -> Dict[str, Any]:
@@ -98,7 +129,27 @@ class Autotuner:
         cfg.pop("train_batch_size", None)  # re-derived from micro batch
         zero = dict(cfg.get("zero_optimization", {}))
         zero["stage"] = exp["zero_stage"]
+        if "offload_device" in exp:
+            if exp["offload_device"] == "none":
+                zero.pop("offload_optimizer", None)
+                # the deprecated alias would re-create the offload block
+                zero.pop("cpu_offload", None)
+            else:
+                # preserve user-set fields (nvme_path, pin_memory, ...)
+                zero["offload_optimizer"] = {
+                    **(zero.get("offload_optimizer") or {}),
+                    "device": exp["offload_device"]}
         cfg["zero_optimization"] = zero
+        if "tp_size" in exp or "remat_policy" in exp:
+            tpu = dict(cfg.get("tpu", {}))
+            if "remat_policy" in exp:
+                tpu["remat"] = exp["remat_policy"]
+            if "tp_size" in exp:
+                mesh = dict(tpu.get("mesh", {}))
+                mesh["tp"] = exp["tp_size"]
+                mesh.setdefault("dp", -1)
+                tpu["mesh"] = mesh
+            cfg["tpu"] = tpu
         return cfg
 
     # ------------------------------------------------------------------

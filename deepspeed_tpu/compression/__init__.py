@@ -15,4 +15,12 @@ from deepspeed_tpu.compression.config import (  # noqa: F401
 from deepspeed_tpu.compression.scheduler import (  # noqa: F401
     CompressionScheduler,
 )
+from deepspeed_tpu.compression.basic_layer import (  # noqa: F401
+    BNLayerCompress,
+    ColumnParallelLinearCompress,
+    Conv2dLayerCompress,
+    EmbeddingCompress,
+    LinearLayerCompress,
+    RowParallelLinearCompress,
+)
 from deepspeed_tpu.compression import functional  # noqa: F401

@@ -117,3 +117,63 @@ class TestAutotunerEndToEnd:
         assert best_cfg["zero_optimization"]["stage"] in (0, 1)
         # every generated experiment was evaluated (grid search)
         assert len(at.records) == 4
+
+
+class TestWidenedSearchSpace:
+    """TPU-dimension sweep (remat policy x mesh axes x offload, VERDICT
+    'widen the autotuner space'): the experiment generator multiplies the
+    optional dimensions in, exp_to_config maps them onto tpu/zero blocks,
+    and a model-based sweep over >=3 dimensions runs real engines."""
+
+    def test_dimensions_multiply_in(self):
+        at = Autotuner({}, {"zero_stages": [0],
+                            "num_tuning_micro_batch_sizes": 1,
+                            "tp_sizes": [1, 2],
+                            "remat_policies": ["none", "selective"],
+                            "offload_devices": ["none", "cpu"]})
+        exps = at.generate_experiments()
+        assert len(exps) == 8
+        cfg = at.exp_to_config(
+            {"zero_stage": 0, "train_micro_batch_size_per_gpu": 2,
+             "tp_size": 2, "remat_policy": "selective",
+             "offload_device": "cpu"})
+        assert cfg["tpu"]["mesh"]["tp"] == 2
+        assert cfg["tpu"]["remat"] == "selective"
+        assert cfg["zero_optimization"]["offload_optimizer"] == {
+            "device": "cpu"}
+        cfg0 = at.exp_to_config(
+            {"zero_stage": 0, "train_micro_batch_size_per_gpu": 2,
+             "tp_size": 1, "remat_policy": "none",
+             "offload_device": "none"})
+        assert "offload_optimizer" not in cfg0["zero_optimization"]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="remat"):
+            AutotuningConfig({"remat_policies": ["sometimes"]})
+        with pytest.raises(ValueError, match="offload"):
+            AutotuningConfig({"offload_devices": ["gpu"]})
+
+    def test_model_based_sweep_three_dims(self, eight_devices):
+        """Real engines across zero_stage x micro x remat x offload with
+        the model-based tuner on the CPU mesh."""
+        at = Autotuner(
+            {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+             "steps_per_print": 10 ** 9},
+            {"zero_stages": [0, 1],
+             "min_train_micro_batch_size_per_gpu": 1,
+             "max_train_micro_batch_size_per_gpu": 2,
+             "num_tuning_micro_batch_sizes": 2,
+             "remat_policies": ["none", "selective"],
+             "offload_devices": ["none", "cpu"],
+             "tuner_type": "model_based",
+             "tuner_num_trials": 10,
+             "start_profile_step": 1,
+             "end_profile_step": 2})
+        exps = at.generate_experiments()
+        assert len(exps) == 16
+        best = at.tune(lambda: SimpleModel(hidden_dim=16),
+                       random_dataset(64))
+        assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+        assert "remat" in best.get("tpu", {})
+        evaluated = [m for _, m in at.records if m is not None]
+        assert len(evaluated) >= 3  # real engines ran across the space
