@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Pallas flash attention vs XLA einsum attention: training-step TFLOPS
+across sequence lengths (decides use_flash_attention="auto"; SURVEY §2.4
+flash rows).
+
+Measured (GPT-2 125M, one v5e chip, 8192 tokens/batch, selective remat):
+
+    seq   micro   XLA TFLOPS   flash TFLOPS   winner
+    128     64      55.7          45.3        XLA
+    512     16      44.9          49.2        flash
+    2048     4      25.1          46.7        flash (1.9x)
+    4096     2      12.4          47.6        flash (3.8x)
+
+=> FLASH_AUTO_MIN_SEQ = 512 (models/transformer_lm.py): the [T, T] score
+materialization XLA does stops fitting VMEM-friendly tiles past ~512.
+
+  python benchmarks/flash_sweep.py --model gpt2-125m --seqs 128 512 2048 4096
+"""
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._util import fence  # noqa: E402
+
+
+def run(model_name, seq, flash, micro, steps=5):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer_lm import (
+        GPT,
+        gpt2_config,
+        num_params,
+    )
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    cfg = gpt2_config(model_name, n_positions=seq, dtype=jnp.bfloat16,
+                      scan_layers=True, remat=True,
+                      remat_policy="selective",
+                      use_flash_attention=flash)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT(cfg), config={
+        "train_micro_batch_size_per_gpu": micro,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "FusedAdam", "params": {"lr": 6e-4}},
+        "steps_per_print": 10 ** 9,
+    })
+    gb = micro * engine.topology.data_parallel_size
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(
+        0, cfg.vocab_size, size=(gb, seq)).astype(np.int32)}
+    batch["labels"] = batch["input_ids"]
+    it = iter(RepeatingLoader([batch]))
+    engine.train_batch(it)
+    engine.train_batch(it)
+    fence(engine.params)
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(it)
+    fence(engine.params)
+    dt = (time.time() - t0) / steps
+
+    n_params = num_params(cfg)
+    embed = cfg.vocab_size * cfg.n_embd
+    attn = 6 * cfg.n_layer * cfg.n_embd * seq
+    fpt = 6.0 * (n_params - embed) + attn
+    return round(gb * seq * fpt / dt / 1e12, 2), round(dt * 1e3, 1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2-125m")
+    p.add_argument("--seqs", type=int, nargs="+",
+                   default=[128, 512, 2048, 4096])
+    p.add_argument("--tokens-per-batch", type=int, default=8192)
+    args = p.parse_args()
+
+    for seq in args.seqs:
+        micro = max(1, args.tokens_per_batch // seq)
+        row = {"model": args.model, "seq": seq, "micro": micro}
+        for flash in (False, True):
+            try:
+                tflops, ms = run(args.model, seq, flash, micro)
+                row["flash" if flash else "xla"] = tflops
+                row[("flash" if flash else "xla") + "_ms"] = ms
+            except Exception as e:
+                row["flash" if flash else "xla"] = f"error: {str(e)[:80]}"
+            gc.collect()
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,7 +64,13 @@ class GPTConfig:
     # MXU work is saved, cheap VPU work is redone
     remat_policy: str = "full"
     scan_layers: bool = True
-    use_flash_attention: bool = False  # Pallas kernel path (ops/pallas)
+    # Pallas flash kernel path (ops/pallas). True | False | "auto" —
+    # auto picks per shape from the measured crossover: XLA einsum wins at
+    # short seq (the whole [T,T] score matrix tiles well), flash wins from
+    # FLASH_AUTO_MIN_SEQ up (benchmarks/flash_sweep.py: GPT-2 125M on one
+    # v5e chip — seq 128: 56 vs 45 TFLOPS for XLA; 512: 49 vs 45 flash;
+    # 2048: 47 vs 25; 4096: 48 vs 12)
+    use_flash_attention: Any = False
     # ZeRO-Infinity parameter tier (ops/streaming.py): layer-stack params
     # live in host memory; the scan streams one layer into HBM per step.
     # Pair with ds_config zero_optimization.offload_param (engine places
@@ -102,6 +108,10 @@ class GPTConfig:
             raise ValueError(
                 "param_offload streams layer slices out of the scan; it "
                 "requires scan_layers=True")
+        if self.use_flash_attention not in (True, False, "auto"):
+            raise ValueError(
+                f"use_flash_attention must be True, False or 'auto'; got "
+                f"{self.use_flash_attention!r}")
 
     @property
     def head_dim(self) -> int:
@@ -286,8 +296,12 @@ class CausalSelfAttention(nn.Module):
                 return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
 
         # flash path needs 128-aligned seq (TPU tile constraint), no padding
-        # mask, and no attention dropout (the kernel has none)
-        use_flash = (cfg.use_flash_attention and mask is None
+        # mask, and no attention dropout (the kernel has none). "auto"
+        # selects by the measured seq-length crossover (see GPTConfig).
+        want_flash = (T >= FLASH_AUTO_MIN_SEQ
+                      if cfg.use_flash_attention == "auto"
+                      else cfg.use_flash_attention)
+        use_flash = (want_flash and mask is None
                      and T % 128 == 0 and not cfg.alibi
                      and (cfg.dropout == 0.0 or deterministic))
         if use_flash:
@@ -387,6 +401,12 @@ class Block(nn.Module):
             l_aux = jnp.float32(0.0)
         x = x + y + a if cfg.parallel_residual else x + y
         return x, l_aux
+
+
+# measured crossover for use_flash_attention="auto"
+# (benchmarks/flash_sweep.py, v5e chip): XLA einsum attention wins below
+# this sequence length, the Pallas flash kernel at and above it
+FLASH_AUTO_MIN_SEQ = 512
 
 
 def alibi_slopes(n_head: int) -> np.ndarray:

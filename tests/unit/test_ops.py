@@ -294,3 +294,50 @@ class TestRotary:
         y = apply_rotary_pos_emb(x, rotary_dim=16)
         np.testing.assert_array_equal(np.asarray(y[..., 16:]),
                                       np.asarray(x[..., 16:]))
+
+
+class TestFlashAutoSelect:
+    """use_flash_attention="auto" picks per shape from the measured
+    crossover (benchmarks/flash_sweep.py): XLA einsum below
+    FLASH_AUTO_MIN_SEQ, the Pallas kernel at and above it."""
+
+    def _logits(self, flash, T):
+        import jax
+
+        from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+
+        cfg = GPTConfig(vocab_size=64, n_positions=T, n_embd=32, n_layer=1,
+                        n_head=2, dtype=jnp.float32,
+                        param_dtype=jnp.float32, scan_layers=True,
+                        use_flash_attention=flash, dropout=0.0)
+        model = GPT(cfg)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, 64, size=(1, T)))
+        params = model.init(jax.random.PRNGKey(0), ids,
+                            deterministic=True)["params"]
+        return np.asarray(model.apply({"params": params}, ids,
+                                      deterministic=True))
+
+    def test_auto_below_crossover_is_xla(self):
+        # bitwise-equal to the explicit XLA path
+        np.testing.assert_array_equal(self._logits("auto", 256),
+                                      self._logits(False, 256))
+
+    def test_auto_at_crossover_is_flash(self):
+        from deepspeed_tpu.models.transformer_lm import FLASH_AUTO_MIN_SEQ
+
+        T = FLASH_AUTO_MIN_SEQ
+        np.testing.assert_array_equal(self._logits("auto", T),
+                                      self._logits(True, T))
+        # and flash really differs bit-wise from XLA (different kernels)
+        assert not np.array_equal(self._logits(True, T),
+                                  self._logits(False, T))
+
+    def test_invalid_value_rejected(self):
+        import pytest as _pytest
+
+        from deepspeed_tpu.models.transformer_lm import GPTConfig
+
+        with _pytest.raises(ValueError, match="use_flash_attention"):
+            GPTConfig(n_embd=32, n_layer=1, n_head=2,
+                      use_flash_attention="always")
