@@ -1,7 +1,35 @@
 """Shared benchmark helpers."""
 
+import time
+
 import jax
 import jax.numpy as jnp
+
+
+def gpt_flops_per_token(cfg, seq: int) -> float:
+    """Model (algorithmic) training FLOPs per token for a causal GPT:
+    6N for the non-embedding params + the causal attention term."""
+    from deepspeed_tpu.models.transformer_lm import num_params
+
+    embed = cfg.vocab_size * cfg.n_embd
+    attn = 6 * cfg.n_layer * cfg.n_embd * seq
+    return 6.0 * (num_params(cfg) - embed) + attn
+
+
+def time_train_steps(engine, batch, steps: int = 5,
+                     warmup: int = 2) -> float:
+    """Seconds per train_batch, warmed and fenced (see ``fence``)."""
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    it = iter(RepeatingLoader([batch]))
+    for _ in range(warmup):
+        engine.train_batch(it)
+    fence(engine.params)
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(it)
+    fence(engine.params)
+    return (time.time() - t0) / steps
 
 
 def fence(tree):

@@ -22,25 +22,18 @@ import gc
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks._util import fence  # noqa: E402
+from benchmarks._util import gpt_flops_per_token, time_train_steps  # noqa: E402
 
 
 def run(model_name, seq, flash, micro, steps=5):
-    import jax
     import jax.numpy as jnp
     import numpy as np
 
     import deepspeed_tpu
-    from deepspeed_tpu.models.transformer_lm import (
-        GPT,
-        gpt2_config,
-        num_params,
-    )
-    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config
 
     cfg = gpt2_config(model_name, n_positions=seq, dtype=jnp.bfloat16,
                       scan_layers=True, remat=True,
@@ -54,23 +47,10 @@ def run(model_name, seq, flash, micro, steps=5):
     })
     gb = micro * engine.topology.data_parallel_size
     rng = np.random.RandomState(0)
-    batch = {"input_ids": rng.randint(
-        0, cfg.vocab_size, size=(gb, seq)).astype(np.int32)}
-    batch["labels"] = batch["input_ids"]
-    it = iter(RepeatingLoader([batch]))
-    engine.train_batch(it)
-    engine.train_batch(it)
-    fence(engine.params)
-    t0 = time.time()
-    for _ in range(steps):
-        engine.train_batch(it)
-    fence(engine.params)
-    dt = (time.time() - t0) / steps
-
-    n_params = num_params(cfg)
-    embed = cfg.vocab_size * cfg.n_embd
-    attn = 6 * cfg.n_layer * cfg.n_embd * seq
-    fpt = 6.0 * (n_params - embed) + attn
+    ids = rng.randint(0, cfg.vocab_size, size=(gb, seq)).astype(np.int32)
+    dt = time_train_steps(engine, {"input_ids": ids, "labels": ids},
+                          steps=steps)
+    fpt = gpt_flops_per_token(cfg, seq)
     return round(gb * seq * fpt / dt / 1e12, 2), round(dt * 1e3, 1)
 
 

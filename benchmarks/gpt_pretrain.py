@@ -16,7 +16,6 @@ memory limit" story. vs_baseline uses that 30-TFLOPS figure.
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -25,9 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
-from benchmarks._util import fence
+from benchmarks._util import gpt_flops_per_token, time_train_steps
 from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config, num_params
-from deepspeed_tpu.runtime.dataloader import RepeatingLoader
 
 BASELINE_TFLOPS = 30.0  # ZeRO-Offload, 1x V100: docs/_pages/training.md:293
 
@@ -60,21 +58,10 @@ def run(model_name="gpt2-1.3b", seq=1024, micro=4, steps=6,
     batch = {"input_ids": rng.randint(0, cfg.vocab_size,
                                       size=(gb, seq)).astype(np.int32)}
     batch["labels"] = batch["input_ids"]
-    it = iter(RepeatingLoader([batch]))
-
-    engine.train_batch(it)
-    engine.train_batch(it)
-    fence(engine.params)
-    t0 = time.time()
-    for _ in range(steps):
-        engine.train_batch(it)
-    fence(engine.params)
-    dt = (time.time() - t0) / steps
+    dt = time_train_steps(engine, batch, steps=steps)
 
     n_params = num_params(cfg)
-    embed = cfg.vocab_size * cfg.n_embd
-    attn = 6 * cfg.n_layer * cfg.n_embd * seq
-    fpt = 6.0 * (n_params - embed) + attn
+    fpt = gpt_flops_per_token(cfg, seq)
     n_dev = len(jax.devices())
     return {
         "model": model_name,

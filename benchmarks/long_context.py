@@ -14,11 +14,10 @@ Prints one JSON line per sequence length: tokens/sec, ms/step, model TFLOPS.
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks._util import fence  # noqa: E402
+from benchmarks._util import gpt_flops_per_token, time_train_steps  # noqa: E402
 
 
 def run(seq: int, micro: int):
@@ -32,7 +31,6 @@ def run(seq: int, micro: int):
         gpt2_config,
         num_params,
     )
-    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
 
     cfg = gpt2_config("gpt2-125m", n_positions=seq, dtype=jnp.bfloat16,
                       scan_layers=True, remat=True, remat_policy="selective",
@@ -50,27 +48,14 @@ def run(seq: int, micro: int):
     b = {"input_ids": rng.randint(0, cfg.vocab_size,
                                   size=(micro, seq)).astype(np.int32)}
     b["labels"] = b["input_ids"]
-    it = iter(RepeatingLoader([b]))
-
-
     try:
-        engine.train_batch(it)
-        engine.train_batch(it)
-        fence(engine.params)
-        steps = 5
-        t0 = time.time()
-        for _ in range(steps):
-            engine.train_batch(it)
-        fence(engine.params)
-        dt = (time.time() - t0) / steps
+        dt = time_train_steps(engine, b, steps=5)
     except Exception as e:
         print(json.dumps({"seq": seq, "micro": micro,
                           "error": str(e)[:100]}), flush=True)
         return
     tokens = micro * seq
-    n = num_params(cfg)
-    fpt = 6.0 * (n - cfg.vocab_size * cfg.n_embd) \
-        + 6 * cfg.n_layer * cfg.n_embd * seq
+    fpt = gpt_flops_per_token(cfg, seq)
     print(json.dumps({
         "seq": seq, "micro": micro,
         "tokens_per_sec": round(tokens / dt),

@@ -4,7 +4,6 @@ import itertools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -13,9 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
-from benchmarks._util import fence
-from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config, num_params
-from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+from benchmarks._util import gpt_flops_per_token, time_train_steps
+from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config
 
 seq = 1024
 
@@ -41,28 +39,13 @@ def run(micro, remat, policy, flash):
     batch = {"input_ids": rng.randint(0, cfg.vocab_size,
                                       size=(gb, seq)).astype(np.int32)}
     batch["labels"] = batch["input_ids"]
-    it = iter(RepeatingLoader([batch]))
-
-
     try:
-        engine.train_batch(it)
-        engine.train_batch(it)
-        fence(engine.params)
-        steps = 6
-        t0 = time.time()
-        for _ in range(steps):
-            engine.train_batch(it)
-        fence(engine.params)
-        dt = (time.time() - t0) / steps
+        dt = time_train_steps(engine, batch, steps=6)
     except Exception as e:  # OOM etc
         print(json.dumps({"micro": micro, "remat": remat, "policy": policy,
                           "flash": flash, "error": str(e)[:120]}), flush=True)
         return
-    n_params = num_params(cfg)
-    embed = cfg.vocab_size * cfg.n_embd
-    attn = 6 * cfg.n_layer * cfg.n_embd * seq
-    fpt = 6.0 * (n_params - embed) + attn
-    tflops = gb * seq * fpt / dt / 1e12
+    tflops = gb * seq * gpt_flops_per_token(cfg, seq) / dt / 1e12
     print(json.dumps({"micro": micro, "remat": remat, "policy": policy,
                       "flash": flash, "tflops": round(tflops, 2),
                       "ms": round(dt * 1000, 1)}), flush=True)

@@ -156,8 +156,21 @@ class OptimizerAdapter:
 
     @property
     def param_groups(self):
-        lr = self._engine.get_lr()[0]
-        return [{"lr": lr, "params": []}]
+        """One group carrying the real hyperparameters and the engine's
+        param leaves (reference torch-optim surface). Read-only: the lr
+        actually applied comes from the schedule/config — mutate via the
+        scheduler or config, not this view (documented divergence)."""
+        eng = self._engine
+        opt_p = dict(eng._config.optimizer.params or {})
+        betas = opt_p.get("betas", (0.9, 0.999))
+        return [{
+            "lr": eng.get_lr()[0],
+            "betas": (float(betas[0]), float(betas[1])),
+            "eps": float(opt_p.get("eps", 1e-8)),
+            "weight_decay": float(opt_p.get("weight_decay", 0.0)),
+            "params": (jax.tree.leaves(eng._params)
+                       if eng._params is not None else []),
+        }]
 
     def state_dict(self):
         return serialization.to_state_dict(self._engine._opt_state)

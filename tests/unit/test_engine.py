@@ -243,3 +243,29 @@ def test_pure_bf16_param_dtype_trains(eight_devices):
     assert all(np.isfinite(losses))
     leaf = jax.tree.leaves(engine.params)[0]
     assert leaf.dtype == jnp.bfloat16
+
+
+def test_optimizer_adapter_param_groups(eight_devices):
+    """The initialize() optimizer handle exposes real hyperparameters and
+    the param leaves (reference torch-optim param_groups surface)."""
+    from unit.simple_model import SimpleModel, random_dataset
+
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    import deepspeed_tpu
+
+    engine, opt, loader, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 3e-4, "betas": [0.9, 0.95],
+                                         "weight_decay": 0.1}},
+                "steps_per_print": 10 ** 9},
+        training_data=random_dataset(64))
+    g = opt.param_groups[0]
+    assert g["lr"] == pytest.approx(3e-4)
+    assert g["betas"] == (0.9, 0.95)
+    assert g["weight_decay"] == pytest.approx(0.1)
+    assert g["params"] == []  # before materialization
+    engine.train_batch(iter(RepeatingLoader(loader)))
+    assert len(opt.param_groups[0]["params"]) > 0
