@@ -26,11 +26,7 @@ def run(seq: int, micro: int):
     import numpy as np
 
     import deepspeed_tpu
-    from deepspeed_tpu.models.transformer_lm import (
-        GPT,
-        gpt2_config,
-        num_params,
-    )
+    from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config
 
     cfg = gpt2_config("gpt2-125m", n_positions=seq, dtype=jnp.bfloat16,
                       scan_layers=True, remat=True, remat_policy="selective",

@@ -161,6 +161,12 @@ class OptimizerAdapter:
         actually applied comes from the schedule/config — mutate via the
         scheduler or config, not this view (documented divergence)."""
         eng = self._engine
+        leaves = (jax.tree.leaves(eng._params)
+                  if eng._params is not None else [])
+        if eng._client_optimizer is not None:
+            # a client optax transformation owns its hyperparameters;
+            # don't fabricate config-block defaults it never saw
+            return [{"lr": eng.get_lr()[0], "params": leaves}]
         opt_p = dict(eng._config.optimizer.params or {})
         betas = opt_p.get("betas", (0.9, 0.999))
         return [{
@@ -168,8 +174,7 @@ class OptimizerAdapter:
             "betas": (float(betas[0]), float(betas[1])),
             "eps": float(opt_p.get("eps", 1e-8)),
             "weight_decay": float(opt_p.get("weight_decay", 0.0)),
-            "params": (jax.tree.leaves(eng._params)
-                       if eng._params is not None else []),
+            "params": leaves,
         }]
 
     def state_dict(self):

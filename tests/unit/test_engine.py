@@ -248,12 +248,6 @@ def test_pure_bf16_param_dtype_trains(eight_devices):
 def test_optimizer_adapter_param_groups(eight_devices):
     """The initialize() optimizer handle exposes real hyperparameters and
     the param leaves (reference torch-optim param_groups surface)."""
-    from unit.simple_model import SimpleModel, random_dataset
-
-    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
-
-    import deepspeed_tpu
-
     engine, opt, loader, _ = deepspeed_tpu.initialize(
         model=SimpleModel(hidden_dim=16),
         config={"train_micro_batch_size_per_gpu": 4,
