@@ -30,16 +30,19 @@ from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config, num_params
 BASELINE_TFLOPS = 30.0  # ZeRO-Offload, 1x V100: docs/_pages/training.md:293
 
 
-def run(model_name="gpt2-1.3b", seq=1024, micro=4, steps=6,
+def run(model_name="gpt2-1.3b", seq=1024, micro=6, steps=6,
         remat_policy="full"):
-    # measured on the v5e chip (micro x policy sweep): micro 4 / full remat =
-    # 81.2 TFLOPS; micro 2 full = 73.9; selective remat OOMs at any micro;
-    # micro >= 5 OOMs. Full remat wins because 1.3B leaves <2 GB for
-    # activations after bf16 params+grads+moments (~10.4 GB).
+    # measured on the v5e chip (micro x policy x flash sweep): flash + full
+    # remat + micro 6 = 102.4 TFLOPS (micro 4: 97.0; micro 7/8 OOM;
+    # selective remat OOMs at any micro). Without flash the best was
+    # micro 4 / full = 81.2 — the kernel's d=128 heads dodge the d=64
+    # attention-dot ceiling AND free the [T,T] score memory, buying two
+    # extra micro batches. 1.3B leaves <2 GB for activations after bf16
+    # params+grads+moments (~10.4 GB).
     cfg = gpt2_config(
         model_name, n_positions=seq, dtype=jnp.bfloat16,
         param_dtype=jnp.bfloat16, scan_layers=True, remat=True,
-        remat_policy=remat_policy)
+        remat_policy=remat_policy, use_flash_attention="auto")
     model = GPT(cfg)
     ds_config = {
         "train_micro_batch_size_per_gpu": micro,
