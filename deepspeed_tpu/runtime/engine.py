@@ -293,6 +293,7 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         self.global_samples = 0
         self._last_loss = None
+        self._last_grad_norm = None
         self._backward_pending = False
         self._step_losses = []
 
@@ -1030,9 +1031,10 @@ class DeepSpeedEngine:
         """Host optimizer step (ZeRO-Offload): grads to host, native fused
         Adam over fp32 masters, compute-dtype params back to device."""
         scale = float(self._ls_state.scale) if self.fp16_enabled else 1.0
-        self._params, overflow, _grad_norm = self._offload_opt.step(
+        self._params, overflow, grad_norm = self._offload_opt.step(
             self._acc_grads, loss_scale=scale,
             global_step=self.global_steps, current_params=self._params)
+        self._last_grad_norm = grad_norm
         if self._offload_param_device == "none":
             if self._zero_acc_fn is None:
                 self._zero_acc_fn = jax.jit(
@@ -1088,6 +1090,8 @@ class DeepSpeedEngine:
                 self._params, self._opt_state, self._acc_grads,
                 self._ls_state
             )
+            if self._compressed_mode is None:
+                self._last_grad_norm = grad_norm
         self.global_steps += 1
         self._post_step_bookkeeping(overflow, self._step_losses)
         self._step_losses = []
@@ -1176,9 +1180,11 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         device_batch = self._put_batch(batch)
         (self._params, self._opt_state, self._ls_state, loss, overflow,
-         _grad_norm) = self._train_step_fn(
+         grad_norm) = self._train_step_fn(
             self._params, self._opt_state, self._ls_state, device_batch,
             self._rng, self.micro_steps)
+        if self._compressed_mode is None:
+            self._last_grad_norm = grad_norm
         self._last_loss = loss
         self.micro_steps += 1
         self.global_steps += 1
@@ -1211,7 +1217,13 @@ class DeepSpeedEngine:
         return [lr]
 
     def get_global_grad_norm(self):
-        return None  # populated after first step via _apply outputs if needed
+        """Pre-clip global gradient norm of the last optimizer step
+        (reference engine.get_global_grad_norm; None before the first step
+        and under compressed exchange, where the averaged-gradient norm is
+        never materialized)."""
+        if self._last_grad_norm is None:
+            return None
+        return float(self._last_grad_norm)
 
     @property
     def loss_scale(self):

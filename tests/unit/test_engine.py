@@ -263,3 +263,17 @@ def test_optimizer_adapter_param_groups(eight_devices):
     assert g["params"] == []  # before materialization
     engine.train_batch(iter(RepeatingLoader(loader)))
     assert len(opt.param_groups[0]["params"]) > 0
+
+
+def test_global_grad_norm_exposed(eight_devices):
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_clipping": 1.0,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 10 ** 9},
+        training_data=random_dataset(64))
+    assert engine.get_global_grad_norm() is None
+    engine.train_batch(iter(RepeatingLoader(loader)))
+    gn = engine.get_global_grad_norm()
+    assert gn is not None and np.isfinite(gn) and gn > 0
