@@ -286,6 +286,7 @@ class DeepSpeedEngine:
         self._offload_param_device = off_param_cfg.get("device", "none")
         self._offload_opt = None
         self._zero_acc_fn = None
+        self._host_grad_acc = None  # offload_param gas>1 host accumulator
 
         # host counters
         self.micro_steps = 0
@@ -484,12 +485,6 @@ class DeepSpeedEngine:
             raise ValueError(
                 "offload_param is configured but the model marks no params "
                 "as streamable (is the model's param_offload flag set?)")
-        if self.gradient_accumulation_steps != 1:
-            raise NotImplementedError(
-                "offload_param currently requires "
-                "gradient_accumulation_steps == 1 (host-memory gradient "
-                "accumulation is not implemented); raise the micro batch "
-                "instead")
         platform = jax.devices()[0].platform
         if platform != "tpu":
             log_dist(
@@ -788,7 +783,9 @@ class DeepSpeedEngine:
         # offload_param: grads of streamed layers land in HOST memory
         # (per-layer, from the streaming bwd); elementwise accumulation on
         # host tensors is not a device op, so the buffer is REPLACED each
-        # micro step (gas == 1 is enforced at init)
+        # micro step — with gas > 1 forward() accumulates host-side numpy
+        # (the grads are host-resident anyway; the host optimizer consumes
+        # them there)
         replace_acc = self._offload_param_device != "none"
 
         def fwd_bwd(params, acc_grads, batch, rng, step, scale):
@@ -1021,6 +1018,18 @@ class DeepSpeedEngine:
             self._params, self._acc_grads, device_batch, self._rng,
             self.micro_steps, scale
         )
+        if (self._offload_param_device != "none"
+                and self.gradient_accumulation_steps > 1):
+            # streamed-param mode replaces the grad tree each micro step;
+            # accumulate host-side f32 (the host optimizer consumes numpy
+            # grads anyway, and each micro grad is already scaled by 1/gas)
+            leaves = jax.tree.leaves(jax.device_get(self._acc_grads))
+            if self._host_grad_acc is None:
+                self._host_grad_acc = [
+                    np.asarray(l, np.float32).copy() for l in leaves]
+            else:
+                for buf, l in zip(self._host_grad_acc, leaves):
+                    buf += np.asarray(l, np.float32)
         self._backward_pending = True
         self._last_loss = loss
         if self.wall_clock_breakdown:
@@ -1031,10 +1040,16 @@ class DeepSpeedEngine:
         """Host optimizer step (ZeRO-Offload): grads to host, native fused
         Adam over fp32 masters, compute-dtype params back to device."""
         scale = float(self._ls_state.scale) if self.fp16_enabled else 1.0
+        grads_src = self._acc_grads
+        if self._host_grad_acc is not None:
+            grads_src = jax.tree.unflatten(
+                jax.tree.structure(self._acc_grads), self._host_grad_acc)
+            self._host_grad_acc = None
         self._params, overflow, grad_norm = self._offload_opt.step(
-            self._acc_grads, loss_scale=scale,
+            grads_src, loss_scale=scale,
             global_step=self.global_steps, current_params=self._params)
-        self._last_grad_norm = grad_norm
+        if np.isfinite(grad_norm):  # skipped overflow step: keep last valid
+            self._last_grad_norm = grad_norm
         if self._offload_param_device == "none":
             if self._zero_acc_fn is None:
                 self._zero_acc_fn = jax.jit(
@@ -1090,7 +1105,10 @@ class DeepSpeedEngine:
                 self._params, self._opt_state, self._acc_grads,
                 self._ls_state
             )
-            if self._compressed_mode is None:
+            # fp16 short-circuit first: bool(overflow) on the device
+            # scalar would force a host sync every step in bf16/f32 mode
+            if self._compressed_mode is None and not (
+                    self.fp16_enabled and bool(overflow)):
                 self._last_grad_norm = grad_norm
         self.global_steps += 1
         self._post_step_bookkeeping(overflow, self._step_losses)
@@ -1183,7 +1201,8 @@ class DeepSpeedEngine:
          grad_norm) = self._train_step_fn(
             self._params, self._opt_state, self._ls_state, device_batch,
             self._rng, self.micro_steps)
-        if self._compressed_mode is None:
+        if self._compressed_mode is None and not (
+                self.fp16_enabled and bool(overflow)):
             self._last_grad_norm = grad_norm
         self._last_loss = loss
         self.micro_steps += 1
@@ -1337,6 +1356,9 @@ class DeepSpeedEngine:
 
         with open(self._engine_states_path(load_dir, tag), "rb") as f:
             meta = pickle.load(f)
+        # a partial accumulation window from before the restore must not
+        # leak into the first post-restore step
+        self._host_grad_acc = None
         restored = serialization.from_state_dict(self._params, model_state["module"])
         self._params = jax.jit(
             lambda t: t, out_shardings=self._param_shardings

@@ -173,16 +173,44 @@ class TestParamOffload:
         with pytest.raises(ValueError, match="offload_optimizer"):
             engine.forward({"input_ids": ids, "labels": ids})
 
-    def test_rejects_gas(self, eight_devices):
+    def test_gas_matches_large_micro(self, eight_devices):
+        """Host-side gradient accumulation: gas=2 @ half micro equals one
+        step at the full micro batch (grads accumulate as numpy on host —
+        the streamed-param tree is replaced every micro step)."""
+        import jax
+
         from deepspeed_tpu.models.transformer_lm import GPT
 
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            model=GPT(self._gpt_cfg()),
-            config=self._ds(gradient_accumulation_steps=2,
-                            train_micro_batch_size_per_gpu=1))
-        ids = np.zeros((8, 64), np.int32)
-        with pytest.raises(NotImplementedError, match="accumulation"):
-            engine.forward({"input_ids": ids, "labels": ids})
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, 256, size=(16, 64)).astype(np.int32)
+
+        import jax.numpy as jnp
+
+        def run(micro, gas):
+            from deepspeed_tpu.parallel import mesh
+            mesh.reset_default_topology()
+            # f32 compute: Adam's first step is sign-like, so bf16 grad
+            # rounding would flip tiny elements between the two runs
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=GPT(self._gpt_cfg(dropout=0.0,
+                                        dtype=jnp.float32)),
+                config=self._ds(train_micro_batch_size_per_gpu=micro,
+                                gradient_accumulation_steps=gas))
+            gb = micro * engine.topology.data_parallel_size
+            for i in range(gas):
+                chunk = ids[i * gb:(i + 1) * gb]
+                engine.forward({"input_ids": chunk, "labels": chunk})
+                engine.backward()
+                engine.step()
+            assert engine.global_steps == 1
+            return jax.tree.leaves(jax.device_get(engine.params))
+
+        p_acc = run(micro=1, gas=2)
+        p_big = run(micro=2, gas=1)
+        for a, b in zip(p_acc, p_big):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=5e-3, atol=5e-4)
 
     def test_requires_streaming_model(self, eight_devices):
         engine, _, _, _ = deepspeed_tpu.initialize(
