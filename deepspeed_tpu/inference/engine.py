@@ -182,6 +182,9 @@ class InferenceEngine:
     def forward(self, input_ids, **kwargs):
         """Full forward returning logits (jit-compiled once — the CUDA-graph
         analogue)."""
+        # model modules read the ambient topology at trace time (VocabEmbed
+        # one-hot vs gather) — re-assert before any lazy compile
+        set_default_topology(self.topology)
         input_ids = jnp.asarray(input_ids)
         if self._params is None or not hasattr(self, "_param_shardings"):
             self._materialize(input_ids)
@@ -252,6 +255,7 @@ class InferenceEngine:
         inference_context.h masked decode; the padding-mask-aware cache
         lives in models/transformer_lm.py's decode attention).
         """
+        set_default_topology(self.topology)
         input_ids = jnp.asarray(input_ids)
         if attention_mask is not None:
             ids_np = np.asarray(input_ids)

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.models.transformer_lm import VocabEmbed
+
 
 @dataclasses.dataclass(frozen=True)
 class BertConfig:
@@ -174,8 +176,8 @@ class BertForPreTraining(nn.Module):
                  labels=None, deterministic=True):
         cfg = self.config
         B, T = input_ids.shape
-        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="word_embeddings")
+        tok = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="word_embeddings")
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        name="position_embeddings")

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.transformer_lm import (
     Block,
     GPTConfig,
+    VocabEmbed,
     cross_entropy_loss,
 )
 from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
@@ -28,8 +29,8 @@ class GPTEmbed(nn.Module):
     def __call__(self, input_ids, *, deterministic: bool = True):
         cfg = self.config
         T = input_ids.shape[1]
-        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="wte")
+        wte = VocabEmbed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="wte")
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wpe")
         x = wte(input_ids) + wpe(jnp.arange(T)[None, :])

@@ -980,6 +980,7 @@ class DeepSpeedEngine:
         """Compute loss for one micro batch. Gradients are computed fused with
         the forward (JAX has no separate backward graph) and cached until
         ``backward()`` commits them — same cost, same calling convention."""
+        set_default_topology(self.topology)
         batch = dict(batch)
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
@@ -1187,6 +1188,11 @@ class DeepSpeedEngine:
         return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
 
     def _train_batch_fused(self, batch):
+        # model modules (VocabEmbed, MoE constraints, sp attention) read the
+        # ambient default topology at TRACE time — re-assert this engine's
+        # mesh so interleaved construction of engines on different meshes
+        # cannot leak a mismatched topology into a lazily-compiled step
+        set_default_topology(self.topology)
         batch = dict(batch)
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
@@ -1216,6 +1222,7 @@ class DeepSpeedEngine:
         return loss
 
     def eval_batch(self, batch: Dict[str, Any]):
+        set_default_topology(self.topology)
         batch = dict(batch)
         if not self._initialized:
             self._init_state(batch)

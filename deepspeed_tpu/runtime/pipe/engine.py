@@ -329,6 +329,9 @@ class PipelineEngine:
     # the 1F1B interpreter (reference _exec_schedule, pipe/engine.py:1361)
     # ------------------------------------------------------------------
     def train_batch(self, data_iter):
+        # stage fns trace lazily and model modules (VocabEmbed) read the
+        # ambient topology at trace time — re-assert this engine's mesh
+        set_default_topology(self.topology)
         M, S = self.micro_batches, self.num_stages
         inputs, labels = [], []
         for _ in range(M):
@@ -396,6 +399,7 @@ class PipelineEngine:
     def eval_batch(self, batch):
         """Wavefront forward (reference InferenceSchedule); returns last-stage
         output (loss if labels present)."""
+        set_default_topology(self.topology)
         x, labels = self._split_batch(batch)
         if not self._initialized:
             self._init_state(self._put(x, 0))

@@ -11,6 +11,15 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Keep XLA's C++ WARNING stream on: tests assert on compile-time diagnostics
+# (e.g. the GSPMD involuntary-full-rematerialization warning in test_zero.py)
+# which a TF_CPP_MIN_LOG_LEVEL >= 2 inherited from the caller would suppress.
+# A deliberately lower (more verbose) inherited level is left alone.
+try:
+    if int(os.environ.get("TF_CPP_MIN_LOG_LEVEL", "1")) > 1:
+        os.environ["TF_CPP_MIN_LOG_LEVEL"] = "1"
+except ValueError:
+    os.environ["TF_CPP_MIN_LOG_LEVEL"] = "1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
