@@ -37,10 +37,20 @@ def _quantize_blocks(flat: jnp.ndarray, block: int
     return q, scale
 
 
+def server_shard_length(n: int, w: int, block: int = 512) -> int:
+    """Length of one rank's reduced shard inside :func:`quantized_all_reduce`
+    (the flat tensor padded to a ``w * block`` multiple, split ``w`` ways) —
+    the shape a caller must allocate for the phase-2 error-feedback buffer."""
+    return (n + ((-n) % (w * block))) // w
+
+
 def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
-                         return_error: bool = False
+                         return_error: bool = False,
+                         server_error: jnp.ndarray = None
                          ) -> Union[jnp.ndarray,
-                                    Tuple[jnp.ndarray, jnp.ndarray]]:
+                                    Tuple[jnp.ndarray, jnp.ndarray],
+                                    Tuple[jnp.ndarray, jnp.ndarray,
+                                          jnp.ndarray]]:
     """Sum-all-reduce with int8 wire format (use inside shard_map/jit).
 
     Returns the reduced tensor in ``x``'s shape/dtype (expect ~1e-2
@@ -51,6 +61,14 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     regardless of ``x``'s dtype: error feedback must accumulate in full
     precision (a bf16 round-trip would drop most of the residual's
     mantissa and defeat the compensation).
+
+    ``server_error`` enables the SECOND round of compensation (reference
+    compressed_allreduce's server_error, runtime/comm/nccl.py:51): pass this
+    rank's ``[server_shard_length(x.size, W, block)]`` f32 residual from the
+    previous step; it is added into the reduced shard before phase-2
+    requantization and the new residual is returned as a third output
+    ``(out, worker_err, new_server_error)``. Without it, phase-2
+    requantization noise (~1/127 relative per step) goes uncompensated.
     """
     w = lax.axis_size(axis)
     shape, dtype = x.shape, x.dtype
@@ -72,6 +90,8 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     contribs = (q_recv.reshape(w, per // block, block).astype(jnp.float32)
                 * s_recv[..., None])
     reduced = jnp.sum(contribs, axis=0).reshape(per)
+    if server_error is not None:
+        reduced = reduced + server_error
 
     # phase 2: re-quantize the reduced shard, all_gather, dequantize
     q2, s2 = _quantize_blocks(reduced, block)
@@ -81,12 +101,15 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     if pad:
         out = out[:n]
     out = out.reshape(shape).astype(dtype)
-    if not return_error:
+    if not return_error and server_error is None:
         return out
     err = flat - dequantize(q, s)
     if pad:
         err = err[:n]
-    return out, err.reshape(shape)
+    if server_error is None:
+        return out, err.reshape(shape)
+    new_server_error = reduced - dequantize(q2, s2)
+    return out, err.reshape(shape), new_server_error
 
 
 def quantization_error(x: jnp.ndarray, block: int = 512) -> jnp.ndarray:

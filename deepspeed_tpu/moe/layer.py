@@ -112,6 +112,23 @@ class MoE(nn.Module):
         return y.reshape(orig_shape), gout.l_aux, gout.exp_counts
 
 
+def expert_axis(path: str, ndim: int) -> Optional[int]:
+    """Index of the expert axis in a :class:`StackedExperts` param (the same
+    layout convention :func:`moe_param_spec` encodes: 3rd-from-last for
+    wi/wg/wo, 2nd-from-last for bi/bo — robust to a leading scan-layer
+    axis), or None for non-expert leaves / shapes too small to carry one
+    (e.g. flattened error-feedback buffers)."""
+    if "experts/" not in path:
+        return None
+    if path.endswith(("experts/wi", "experts/wg", "experts/wo")):
+        ax = ndim - 3
+    elif path.endswith(("experts/bi", "experts/bo")):
+        ax = ndim - 2
+    else:
+        return None
+    return ax if ax >= 0 else None
+
+
 def moe_param_spec(path: str, shape) -> Optional[PartitionSpec]:
     """Expert-parallel PartitionSpec for MoE params, composable with TP rules.
 
@@ -127,12 +144,11 @@ def moe_param_spec(path: str, shape) -> Optional[PartitionSpec]:
             s[int(d)] = a
         return PartitionSpec(*s)
 
-    if "experts/" not in path:
+    ep_ax = expert_axis(path, ndim)  # single source of the layout rule
+    if ep_ax is None:
         return None
     if path.endswith(("experts/wi", "experts/wg")):
-        return spec(**{str(ndim - 3): "ep", str(ndim - 1): "tp"})
+        return spec(**{str(ep_ax): "ep", str(ndim - 1): "tp"})
     if path.endswith("experts/wo"):
-        return spec(**{str(ndim - 3): "ep", str(ndim - 2): "tp"})
-    if path.endswith(("experts/bi", "experts/bo")):
-        return spec(**{str(ndim - 2): "ep"})
-    return None
+        return spec(**{str(ep_ax): "ep", str(ndim - 2): "tp"})
+    return spec(**{str(ep_ax): "ep"})  # bi/bo

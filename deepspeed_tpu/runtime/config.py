@@ -283,6 +283,12 @@ class TpuConfig(ConfigModel):
     # route FusedAdam to the Pallas kernel (ops/pallas/fused_adam.py) instead
     # of optax's XLA-fused chain
     use_pallas_optimizer: bool = False
+    # debug observability for the 1-bit optimizers: materialize the exact
+    # averaged-gradient norm each step via an UNCOMPRESSED pmean (costs a
+    # full fp32 allreduce — defeats the compression, debug only) so
+    # get_global_grad_norm() and monitors keep working. The int8 path
+    # materializes its post-exchange norm for free and ignores this flag.
+    compressed_grad_norm: bool = False
 
     @property
     def mesh_config(self) -> MeshConfig:

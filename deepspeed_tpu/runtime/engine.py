@@ -118,6 +118,20 @@ def initialize(
             raise NotImplementedError(
                 "model_parameters (initial weights) is not supported for "
                 "PipelineModule yet; load a checkpoint instead")
+        cfg_obj = (config if isinstance(config, DeepSpeedConfig)
+                   else DeepSpeedConfig(config))
+        off_param = (cfg_obj.zero_config.offload_param or {})
+        if off_param.get("device") == "nvme":
+            # ZeRO-Infinity parameter SSD tier: host-driven layer sweep
+            # over the LayerSpec list (runtime/zero/param_nvme.py)
+            if training_data is not None or lr_scheduler is not None:
+                raise NotImplementedError(
+                    "offload_param nvme tier: pass batches to train_batch "
+                    "directly (no dataloader/scheduler wiring yet)")
+            from deepspeed_tpu.runtime.zero.param_nvme import NVMeParamEngine
+
+            engine = NVMeParamEngine(module=model, config=cfg_obj, seed=seed)
+            return engine, None, None, None
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 
         engine = PipelineEngine(
@@ -143,6 +157,31 @@ def initialize(
     return engine, engine.optimizer_adapter, dataloader, engine.lr_scheduler
 
 
+class _ParamGroup(dict):
+    """One param group with torch-optim write-through: assigning ``lr``
+    feeds the engine's compiled step (reference users mutate
+    ``param_groups[0]["lr"]`` directly; see DeepSpeedEngine.set_lr for the
+    scheduler interplay). Other keys are a read-only snapshot."""
+
+    def __init__(self, engine, data):
+        super().__init__(data)
+        self._engine = engine
+
+    _BAKED_KEYS = ("betas", "eps", "weight_decay", "momentum", "params")
+
+    def __setitem__(self, key, value):
+        if key == "lr":
+            self._engine.set_lr(value)  # raises BEFORE the view mutates
+        elif key in self._BAKED_KEYS:
+            # these are compiled into the optimizer — a silently-accepted
+            # write that changes nothing is worse than an error
+            raise NotImplementedError(
+                f"param_groups[{key!r}] is baked into the compiled "
+                "optimizer; only 'lr' writes through (rebuild the engine "
+                "to change it)")
+        super().__setitem__(key, value)
+
+
 class OptimizerAdapter:
     """Host-side view of the sharded optimizer state with the torch-optim
     attribute surface the reference returns from initialize()."""
@@ -157,25 +196,39 @@ class OptimizerAdapter:
     @property
     def param_groups(self):
         """One group carrying the real hyperparameters and the engine's
-        param leaves (reference torch-optim surface). Read-only: the lr
-        actually applied comes from the schedule/config — mutate via the
-        scheduler or config, not this view (documented divergence)."""
+        param leaves (reference torch-optim surface). ``group["lr"] = v``
+        writes through to the compiled step (engine.set_lr); the other
+        hyperparameters are baked into the compiled optimizer and the view
+        of them is read-only."""
         eng = self._engine
         leaves = (jax.tree.leaves(eng._params)
                   if eng._params is not None else [])
         if eng._client_optimizer is not None:
             # a client optax transformation owns its hyperparameters;
-            # don't fabricate config-block defaults it never saw
-            return [{"lr": eng.get_lr()[0], "params": leaves}]
+            # don't fabricate config-block defaults it never saw. Still a
+            # _ParamGroup so an lr write raises (via set_lr) instead of
+            # silently doing nothing.
+            return [_ParamGroup(eng, {"lr": eng.get_lr()[0],
+                                      "params": leaves})]
         opt_p = dict(eng._config.optimizer.params or {})
-        betas = opt_p.get("betas", (0.9, 0.999))
-        return [{
-            "lr": eng.get_lr()[0],
-            "betas": (float(betas[0]), float(betas[1])),
-            "eps": float(opt_p.get("eps", 1e-8)),
-            "weight_decay": float(opt_p.get("weight_decay", 0.0)),
-            "params": leaves,
-        }]
+        group = {"lr": eng.get_lr()[0], "params": leaves}
+        # only surface hyperparameters the optimizer family actually has
+        # (an SGD config must not report Adam-shaped betas/eps defaults)
+        name = (eng._config.optimizer.type or "adamw").lower()
+        if "adam" in name or "lamb" in name:
+            betas = opt_p.get("betas", (0.9, 0.999))
+            group["betas"] = (float(betas[0]), float(betas[1]))
+            group["eps"] = float(opt_p.get("eps", 1e-8))
+            group["weight_decay"] = float(opt_p.get("weight_decay", 0.0))
+        elif "adagrad" in name:
+            group["eps"] = float(opt_p.get("eps", 1e-10))
+        elif "sgd" in name:
+            group["momentum"] = float(opt_p.get("momentum", 0.0))
+            group["weight_decay"] = float(opt_p.get("weight_decay", 0.0))
+        else:
+            # unknown/custom type: mirror the config block verbatim
+            group.update({k: v for k, v in opt_p.items() if k != "lr"})
+        return [_ParamGroup(eng, group)]
 
     def state_dict(self):
         return serialization.to_state_dict(self._engine._opt_state)
@@ -215,6 +268,12 @@ class DeepSpeedEngine:
             self._compressed_mode = "int8"
         if self._compressed_mode is not None:
             self._validate_compressed_config(config, topology)
+        # whether the compressed step materializes a real averaged-grad norm
+        # (int8: free from the post-exchange mean; onebit: debug-gated)
+        self._compressed_norm_available = (
+            self._compressed_mode == "int8"
+            or (self._compressed_mode == "onebit"
+                and config.tpu.compressed_grad_norm))
         # ZeRO shards over the fsdp axis: when the user asked for a ZeRO stage
         # but left all data parallelism on `dp`, move it to `fsdp` (the mesh
         # expression of "partition across the DP world",
@@ -341,6 +400,10 @@ class DeepSpeedEngine:
         self._fwd_bwd_fn = None
         self._apply_fn = None
         self._eval_fn = None
+        # write-through param_groups["lr"]: an absolute lr override applied
+        # as a multiplicative factor on the compiled step's updates (updates
+        # are linear in lr). None = follow the schedule/config.
+        self._lr_override = None
 
         log_dist(
             f"DeepSpeedEngine: mesh={topology}, zero_stage={self.zero_stage}, "
@@ -353,12 +416,12 @@ class DeepSpeedEngine:
     # configuration
     # ------------------------------------------------------------------
     def _validate_compressed_config(self, config, topology):
-        """Constraints shared by the 1-bit optimizers and int8 grad comm."""
+        """Constraints shared by the 1-bit optimizers and int8 grad comm.
+        fp16 dynamic loss scaling composes (reference fp16/onebit/adam.py:10
+        pairs OnebitAdam with the FP16 wrapper): the compressed step
+        cond-skips the exchange+update on overflow with error-feedback
+        state carried through untouched."""
         mode = self._compressed_mode
-        if config.fp16.enabled:
-            raise ValueError(
-                f"{mode} compressed gradient exchange does not support fp16 "
-                "dynamic loss scaling; use bf16 (TPU-native) or fp32")
         max_stage = 1 if mode == "onebit" else 0
         if config.zero_config.stage > max_stage:
             raise ValueError(
@@ -376,12 +439,13 @@ class DeepSpeedEngine:
             raise ValueError(
                 f"{mode} compressed gradient exchange cannot combine with "
                 "offload_optimizer (the host step bypasses the exchange)")
-        if config.gradient_clipping:
+        if config.gradient_clipping and mode == "onebit":
             logger.warning(
-                "gradient_clipping is ignored with %s compressed exchange: "
-                "the global norm of the averaged gradient is never "
-                "materialized (divergence documented in docs/DIVERGENCES.md)",
-                mode)
+                "gradient_clipping is ignored with the 1-bit optimizers: "
+                "they exchange sign-compressed MOMENTUM, so the averaged "
+                "gradient the clip would apply to never exists (divergence "
+                "documented in docs/DIVERGENCES.md). The int8 "
+                "communication_data_type path clips exactly.")
         if mode == "onebit" and config.zero_config.stage == 1:
             log_dist(
                 "OnebitAdam with ZeRO stage 1: optimizer state stays "
@@ -462,8 +526,10 @@ class DeepSpeedEngine:
         the full parameter set."""
         if self._offload_param_device != "cpu":
             raise NotImplementedError(
-                "offload_param device must be 'cpu' (pinned host memory); "
-                f"got {self._offload_param_device!r}")
+                "offload_param device must be 'cpu' (pinned host memory) "
+                "for the SPMD engine; the 'nvme' tier runs as a layer sweep "
+                "over a PipelineModule (runtime/zero/param_nvme.py) — got "
+                f"{self._offload_param_device!r}")
         if self._offload_device == "none":
             raise ValueError(
                 "offload_param requires offload_optimizer: the host "
@@ -644,16 +710,31 @@ class DeepSpeedEngine:
                 init_global, mesh=mesh, in_specs=(self._param_specs,),
                 out_specs=self._opt_specs, check_vma=False))(self._params)
         else:  # int8 quantized grad allreduce, any optax optimizer
+            from deepspeed_tpu.comm.compressed import server_shard_length
+
             inner = jax.jit(self._tx.init)(self._params)
             err = jax.jit(
                 lambda p: jax.tree.map(
                     lambda x: jnp.zeros((self._comp_k,) + x.shape,
                                         jnp.float32), p),
                 out_shardings=self._grad_shardings)(self._params)
-            self._opt_state = (inner, err)
+            # phase-2 (server) error-feedback buffers: one reduced-shard
+            # residual per worker per leaf (reference compressed_allreduce
+            # compensates both quantization rounds, runtime/comm/nccl.py:51)
+            serr_shardings = jax.tree.map(
+                lambda x: x.sharding, err)
+            serr = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.zeros(
+                        (self._comp_k,
+                         server_shard_length(x.size, self._comp_k)),
+                        jnp.float32), p),
+                out_shardings=serr_shardings)(self._params)
+            self._opt_state = (inner, err, serr)
             self._opt_specs = (
                 jax.tree.map(lambda _: P(), inner),
-                jax.tree.map(lambda _: P(axis), err))
+                jax.tree.map(lambda _: P(axis), err),
+                jax.tree.map(lambda _: P(axis), serr))
         self._opt_shardings = jax.tree.map(
             lambda x: x.sharding, self._opt_state)
 
@@ -667,9 +748,20 @@ class DeepSpeedEngine:
         k = self._comp_k
         mode = self._compressed_mode
 
-        def apply_step(params, opt_state, grads_pw):
+        clip = self.gradient_clipping
+        debug_norm = self._config.tpu.compressed_grad_norm
+
+        def apply_step(params, opt_state, grads_pw, lr_factor):
             local_g = jax.tree.map(lambda g: g[0], grads_pw)  # [1,*s]->[*s]
             if mode == "onebit":
+                if debug_norm:
+                    # debug-only exact pmean: a full fp32 allreduce beside
+                    # the compressed exchange (tpu.compressed_grad_norm)
+                    g_avg = jax.tree.map(
+                        lambda g: jax.lax.pmean(g, "dp"), local_g)
+                    grad_norm = optax.global_norm(g_avg)
+                else:
+                    grad_norm = jnp.float32(0.0)
                 st = opt_state._replace(
                     worker_error=jax.tree.map(
                         lambda x: x[0], opt_state.worker_error),
@@ -677,6 +769,8 @@ class DeepSpeedEngine:
                         lambda x: x[0], opt_state.server_error))
                 # grads stay f32: the 1-bit state (momentum, errors) is f32
                 updates, new_st = tx.update(local_g, st, params)
+                updates = jax.tree.map(
+                    lambda u: (u * lr_factor).astype(u.dtype), updates)
                 new_params = optax.apply_updates(params, updates)
                 new_opt = new_st._replace(
                     worker_error=jax.tree.map(
@@ -686,26 +780,39 @@ class DeepSpeedEngine:
             else:
                 from deepspeed_tpu.comm.compressed import quantized_all_reduce
 
-                inner, err = opt_state
-                reduced, new_err = [], []
+                inner, err, serr = opt_state
+                reduced, new_err, new_serr = [], [], []
                 flat_g, treedef = jax.tree.flatten(local_g)
-                for g, e in zip(flat_g, jax.tree.leaves(err)):
-                    r, e2 = quantized_all_reduce(
-                        g + e[0], "dp", return_error=True)
+                for g, e, se in zip(flat_g, jax.tree.leaves(err),
+                                    jax.tree.leaves(serr)):
+                    r, e2, se2 = quantized_all_reduce(
+                        g + e[0], "dp", return_error=True,
+                        server_error=se[0])
                     reduced.append(r / k)
                     new_err.append(e2[None])
+                    new_serr.append(se2[None])
                 mean_g = jax.tree.unflatten(treedef, reduced)
+                # the post-exchange mean is materialized anyway: its norm is
+                # free, and gradient_clipping gets exact semantics
+                grad_norm = optax.global_norm(mean_g)
+                if clip and clip > 0:
+                    factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                    mean_g = jax.tree.map(lambda g: g * factor, mean_g)
                 mean_g = jax.tree.map(lambda g, p: g.astype(p.dtype),
                                       mean_g, params)
                 updates, new_inner = tx.update(mean_g, inner, params)
+                updates = jax.tree.map(
+                    lambda u: (u * lr_factor).astype(u.dtype), updates)
                 new_params = optax.apply_updates(params, updates)
-                new_opt = (new_inner, jax.tree.unflatten(treedef, new_err))
-            return new_params, new_opt
+                new_opt = (new_inner, jax.tree.unflatten(treedef, new_err),
+                           jax.tree.unflatten(treedef, new_serr))
+            return new_params, new_opt, grad_norm
 
         return jax.shard_map(
             apply_step, mesh=mesh,
-            in_specs=(self._param_specs, self._opt_specs, self._grad_specs),
-            out_specs=(self._param_specs, self._opt_specs),
+            in_specs=(self._param_specs, self._opt_specs, self._grad_specs,
+                      P()),
+            out_specs=(self._param_specs, self._opt_specs, P()),
             check_vma=False)
 
     def _grouped_grads(self, params, batch, rng, step, loss_scale):
@@ -749,26 +856,56 @@ class DeepSpeedEngine:
             out_shardings=(self._grad_shardings, None),
         )
 
+    def _guarded_compressed_update(self, core, params, opt_state, grads,
+                                   ls_state, lr_factor):
+        """Overflow-guarded compressed exchange (trace-level, shared by the
+        fused and unfused step builders): on fp16 overflow the exchange and
+        update are cond-skipped with the error-feedback buffers and the
+        optimizer count untouched (reference fp16+onebit skip semantics,
+        fp16/onebit/adam.py:10)."""
+        overflow = (has_overflow(grads) if self.fp16_enabled
+                    else jnp.bool_(False))
+
+        def do_update(operand):
+            params, opt_state, grads = operand
+            return core(params, opt_state, grads, lr_factor)
+
+        def skip_update(operand):
+            params, opt_state, _ = operand
+            return params, opt_state, jnp.float32(0.0)
+
+        new_params, new_opt, grad_norm = jax.lax.cond(
+            overflow, skip_update, do_update, (params, opt_state, grads))
+        new_ls = update_loss_scale(ls_state, overflow, self._ls_config)
+        return new_params, new_opt, new_ls, overflow, grad_norm
+
     def _build_apply_compressed(self):
         core = self._compressed_apply_core()
 
-        def apply_step(params, opt_state, acc_grads, ls_state):
-            new_params, new_opt = core(params, opt_state, acc_grads)
+        def apply_step(params, opt_state, acc_grads, ls_state, lr_factor):
+            grads = jax.tree.map(lambda g: g / ls_state.scale, acc_grads)
+            new_params, new_opt, new_ls, overflow, grad_norm = \
+                self._guarded_compressed_update(
+                    core, params, opt_state, grads, ls_state, lr_factor)
             zero_acc = jax.tree.map(jnp.zeros_like, acc_grads)
-            return (new_params, new_opt, zero_acc, ls_state,
-                    jnp.bool_(False), jnp.float32(0.0))
+            return (new_params, new_opt, zero_acc, new_ls,
+                    overflow, grad_norm)
 
         return jax.jit(apply_step, donate_argnums=(0, 1, 2))
 
     def _build_train_step_compressed(self):
         core = self._compressed_apply_core()
 
-        def train_step(params, opt_state, ls_state, batch, rng, step):
-            grads, loss = self._grouped_grads(params, batch, rng, step, 1.0)
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-            new_params, new_opt = core(params, opt_state, grads)
-            return (new_params, new_opt, ls_state, loss,
-                    jnp.bool_(False), jnp.float32(0.0))
+        def train_step(params, opt_state, ls_state, batch, rng, step,
+                       lr_factor):
+            grads, loss = self._grouped_grads(
+                params, batch, rng, step, ls_state.scale)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32) / ls_state.scale, grads)
+            new_params, new_opt, new_ls, overflow, grad_norm = \
+                self._guarded_compressed_update(
+                    core, params, opt_state, grads, ls_state, lr_factor)
+            return (new_params, new_opt, new_ls, loss, overflow, grad_norm)
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
@@ -825,7 +962,7 @@ class DeepSpeedEngine:
         check_fp16 = self.fp16_enabled
         ls_config = self._ls_config
 
-        def apply_step(params, opt_state, acc_grads, ls_state):
+        def apply_step(params, opt_state, acc_grads, ls_state, lr_factor):
             grads = jax.tree.map(lambda g: g / ls_state.scale, acc_grads)
             overflow = has_overflow(grads) if check_fp16 else jnp.bool_(False)
             grad_norm = optax.global_norm(grads)
@@ -843,6 +980,9 @@ class DeepSpeedEngine:
                 grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
                                      grads, params)
                 updates, new_opt = tx.update(grads, opt_state, params)
+                # write-through lr: updates are linear in lr (see set_lr)
+                updates = jax.tree.map(
+                    lambda u: (u * lr_factor).astype(u.dtype), updates)
                 new_params = optax.apply_updates(params, updates)
                 return new_params, new_opt
 
@@ -878,7 +1018,8 @@ class DeepSpeedEngine:
         check_fp16 = self.fp16_enabled
         ls_config = self._ls_config
 
-        def train_step(params, opt_state, ls_state, batch, rng, step):
+        def train_step(params, opt_state, ls_state, batch, rng, step,
+                       lr_factor):
             rng = jax.random.fold_in(rng, step)
 
             def loss_fn(p):
@@ -905,6 +1046,9 @@ class DeepSpeedEngine:
                 grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
                                      grads, params)
                 updates, new_opt = tx.update(grads, opt_state, params)
+                # write-through lr: updates are linear in lr (see set_lr)
+                updates = jax.tree.map(
+                    lambda u: (u * lr_factor).astype(u.dtype), updates)
                 return optax.apply_updates(params, updates), new_opt
 
             def skip_update(operand):
@@ -1048,7 +1192,8 @@ class DeepSpeedEngine:
             self._host_grad_acc = None
         self._params, overflow, grad_norm = self._offload_opt.step(
             grads_src, loss_scale=scale,
-            global_step=self.global_steps, current_params=self._params)
+            global_step=self.global_steps, current_params=self._params,
+            lr_override=self._lr_override)
         if np.isfinite(grad_norm):  # skipped overflow step: keep last valid
             self._last_grad_norm = grad_norm
         if self._offload_param_device == "none":
@@ -1104,11 +1249,12 @@ class DeepSpeedEngine:
                 self._ls_state, overflow, grad_norm,
             ) = self._apply_fn(
                 self._params, self._opt_state, self._acc_grads,
-                self._ls_state
+                self._ls_state, self._lr_factor_now()
             )
             # fp16 short-circuit first: bool(overflow) on the device
             # scalar would force a host sync every step in bf16/f32 mode
-            if self._compressed_mode is None and not (
+            if (self._compressed_mode is None
+                    or self._compressed_norm_available) and not (
                     self.fp16_enabled and bool(overflow)):
                 self._last_grad_norm = grad_norm
         self.global_steps += 1
@@ -1129,6 +1275,9 @@ class DeepSpeedEngine:
             )
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
+            # torch parity: an explicit scheduler re-asserts the schedule
+            # over a manual param_groups["lr"] set (see set_lr)
+            self._lr_override = None
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         if self.quantizer is not None:
@@ -1206,8 +1355,9 @@ class DeepSpeedEngine:
         (self._params, self._opt_state, self._ls_state, loss, overflow,
          grad_norm) = self._train_step_fn(
             self._params, self._opt_state, self._ls_state, device_batch,
-            self._rng, self.micro_steps)
-        if self._compressed_mode is None and not (
+            self._rng, self.micro_steps, self._lr_factor_now())
+        if (self._compressed_mode is None
+                or self._compressed_norm_available) and not (
                 self.fp16_enabled and bool(overflow)):
             self._last_grad_norm = grad_norm
         self._last_loss = loss
@@ -1237,16 +1387,59 @@ class DeepSpeedEngine:
     # introspection
     # ------------------------------------------------------------------
     def get_lr(self):
+        if self._lr_override is not None:
+            return [self._lr_override]
         if self.lr_scheduler is not None:
             return self.lr_scheduler.get_last_lr()
         lr = self._config.optimizer.params.get("lr", 0.0)
         return [lr]
 
+    def _scheduled_lr(self) -> float:
+        """The lr the compiled optimizer will apply at the CURRENT step
+        (what the baked-in schedule or config constant evaluates to). The
+        compiled optimizer samples its own optax count, which only advances
+        on non-skipped steps — index the schedule the same way, or the
+        override factor would divide by the wrong base after fp16 skips."""
+        if self._schedule_fn is not None:
+            return float(self._schedule_fn(
+                self.global_steps - self.skipped_steps))
+        return float(self._config.optimizer.params.get("lr", 1e-3))
+
+    def set_lr(self, lr: float) -> None:
+        """Write-through lr (reference users mutate
+        ``optimizer.param_groups[0]["lr"]`` directly): overrides the
+        schedule with an absolute lr from the next step on. Torch-parity
+        scheduler interplay: with an active lr_scheduler the override lasts
+        one step (``scheduler.step()`` re-asserts the schedule, exactly as
+        torch schedulers overwrite manual sets); without one it persists.
+        Implemented as a per-step factor ``lr / scheduled_lr`` multiplied
+        into the compiled step's updates — no recompile."""
+        if self._client_optimizer is not None:
+            raise NotImplementedError(
+                "set_lr/param_groups['lr'] write-through needs the engine-"
+                "built optimizer; a client optax transformation owns its "
+                "own hyperparameters")
+        self._lr_override = float(lr)
+
+    def _lr_factor_now(self):
+        """f32 scalar factor for the compiled step (1.0 = no override)."""
+        if self._lr_override is None:
+            return jnp.float32(1.0)
+        base = self._scheduled_lr()
+        if abs(base) < 1e-30:
+            logger.warning(
+                "param_groups lr override %.3g ignored for this step: the "
+                "scheduled lr is 0 and updates scale multiplicatively",
+                self._lr_override)
+            return jnp.float32(1.0)
+        return jnp.float32(self._lr_override / base)
+
     def get_global_grad_norm(self):
         """Pre-clip global gradient norm of the last optimizer step
-        (reference engine.get_global_grad_norm; None before the first step
-        and under compressed exchange, where the averaged-gradient norm is
-        never materialized)."""
+        (reference engine.get_global_grad_norm). None before the first step
+        and under the 1-bit optimizers unless ``tpu.compressed_grad_norm``
+        enables the debug pmean; the int8 path always materializes it from
+        the post-exchange mean."""
         if self._last_grad_norm is None:
             return None
         return float(self._last_grad_norm)
@@ -1281,6 +1474,50 @@ class DeepSpeedEngine:
             ckpt_dir, str(tag), "zero_pp_rank_0_mp_rank_00_optim_states.msgpack"
         )
 
+    def _expert_states_path(self, ckpt_dir, tag, e, kind="model"):
+        from deepspeed_tpu.runtime.moe_checkpoint import expert_states_filename
+
+        return os.path.join(ckpt_dir, str(tag), expert_states_filename(e, kind))
+
+    def _save_sharded(self, sd, ckpt_dir, tag, kind, dense_payload):
+        """Save a state dict with expert leaves split into per-expert files
+        (reference _save_moe_checkpoint, engine.py:2965: no host ever
+        gathers the full expert set); dense models save one file as before.
+        ``dense_payload(dense_sd, meta)`` shapes the main file's dict."""
+        from deepspeed_tpu.runtime import moe_checkpoint as mc
+
+        from deepspeed_tpu.utils.tree import flatten_dots
+
+        expert_info = mc.find_expert_leaves(sd)
+        path = (self._model_states_path(ckpt_dir, tag) if kind == "model"
+                else self._optim_states_path(ckpt_dir, tag))
+        if not expert_info:
+            self.checkpoint_engine.save(dense_payload(sd, None), path)
+            return
+        dense_sd, meta, n_files = mc.split_expert_sd(sd, expert_info)
+        flat = flatten_dots(sd)  # once, not per expert file
+        expert_leaves = {p: flat[p] for p in expert_info}
+        for e in range(n_files):
+            self.checkpoint_engine.save(
+                {"experts": mc.expert_slice(expert_leaves, expert_info, e)},
+                self._expert_states_path(ckpt_dir, tag, e, kind))
+        self.checkpoint_engine.save(dense_payload(dense_sd, meta), path)
+
+    def _merge_expert_files(self, dense_sd, meta, load_dir, tag, kind):
+        """Load-side inverse of :meth:`_save_sharded`: re-stack per-expert
+        files into the full leaves. No-op for dense checkpoints."""
+        if not meta:
+            return dense_sd
+        from deepspeed_tpu.runtime import moe_checkpoint as mc
+
+        n_files = int(max(meta["counts"].values()))
+        slices = {
+            e: self.checkpoint_engine.load(
+                self._expert_states_path(load_dir, tag, e, kind))["experts"]
+            for e in range(n_files)
+        }
+        return mc.merge_expert_slices(dense_sd, meta, slices)
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         assert self._initialized, "cannot checkpoint before first batch"
@@ -1288,9 +1525,11 @@ class DeepSpeedEngine:
             tag = f"global_step{self.global_steps}"
         client_state = client_state or {}
 
-        self.checkpoint_engine.save(
-            {"module": serialization.to_state_dict(self._params)},
-            self._model_states_path(save_dir, tag),
+        self._save_sharded(
+            serialization.to_state_dict(self._params), save_dir, tag,
+            "model",
+            lambda sd, meta: ({"module": sd, "moe_experts": meta}
+                              if meta else {"module": sd}),
         )
         meta = {
             "global_steps": self.global_steps,
@@ -1305,19 +1544,26 @@ class DeepSpeedEngine:
 
         with open(self._engine_states_path(save_dir, tag), "wb") as f:
             pickle.dump(meta, f)
-        optim_state = {
-            "optimizer": (self._offload_opt.state_dict()
-                          if self._offload_opt is not None
-                          else serialization.to_state_dict(self._opt_state)),
-            "loss_scale": {
-                "scale": np.float32(self._ls_state.scale),
-                "good_steps": np.int32(self._ls_state.good_steps),
-                "hysteresis": np.int32(self._ls_state.hysteresis),
-            },
+        ls_payload = {
+            "scale": np.float32(self._ls_state.scale),
+            "good_steps": np.int32(self._ls_state.good_steps),
+            "hysteresis": np.int32(self._ls_state.hysteresis),
         }
-        self.checkpoint_engine.save(
-            optim_state, self._optim_states_path(save_dir, tag)
-        )
+        if self._offload_opt is not None:
+            self.checkpoint_engine.save(
+                {"optimizer": self._offload_opt.state_dict(),
+                 "loss_scale": ls_payload},
+                self._optim_states_path(save_dir, tag))
+        else:
+            self._save_sharded(
+                serialization.to_state_dict(self._opt_state), save_dir, tag,
+                "optim",
+                lambda sd, meta: (
+                    {"optimizer": sd, "moe_experts": meta,
+                     "loss_scale": ls_payload}
+                    if meta else
+                    {"optimizer": sd, "loss_scale": ls_payload}),
+            )
         # commit BEFORE advertising 'latest': with the async engine the
         # pointer must never name a tag whose files haven't durably landed
         self.checkpoint_engine.commit(tag)
@@ -1366,7 +1612,10 @@ class DeepSpeedEngine:
         # a partial accumulation window from before the restore must not
         # leak into the first post-restore step
         self._host_grad_acc = None
-        restored = serialization.from_state_dict(self._params, model_state["module"])
+        model_sd = self._merge_expert_files(
+            model_state["module"], model_state.get("moe_experts"),
+            load_dir, tag, "model")
+        restored = serialization.from_state_dict(self._params, model_sd)
         self._params = jax.jit(
             lambda t: t, out_shardings=self._param_shardings
         )(restored)
@@ -1391,8 +1640,20 @@ class DeepSpeedEngine:
             if self._offload_opt is not None:
                 self._offload_opt.load_state_dict(optim_state["optimizer"])
             else:
+                opt_sd = self._merge_expert_files(
+                    optim_state["optimizer"],
+                    optim_state.get("moe_experts"), load_dir, tag, "optim")
+                if (self._compressed_mode == "int8"
+                        and isinstance(opt_sd, dict)
+                        and "2" not in opt_sd and "1" in opt_sd):
+                    # migrate pre-server-error int8 checkpoints (state was
+                    # (inner, worker_err); "2" = the phase-2 residuals):
+                    # fresh zeros are the correct cold-start for EF buffers
+                    opt_sd = dict(opt_sd)
+                    opt_sd["2"] = serialization.to_state_dict(
+                        self._opt_state[2])
                 restored_opt = serialization.from_state_dict(
-                    self._opt_state, optim_state["optimizer"]
+                    self._opt_state, opt_sd
                 )
                 self._opt_state = jax.jit(
                     lambda t: t, out_shardings=self._opt_shardings

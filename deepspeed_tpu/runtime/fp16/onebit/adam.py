@@ -202,7 +202,10 @@ def onebit_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         bias2 = 1 - b2 ** jnp.maximum(
             jnp.minimum(count, warmup_steps), 1).astype(jnp.float32)
 
-        lr = (learning_rate(count) if callable(learning_rate)
+        # schedules are sampled at the PRE-increment count: optax
+        # transformations index schedules from step 0, and a compressed run
+        # must see the same warmup point as the same config uncompressed
+        lr = (learning_rate(state.count) if callable(learning_rate)
               else learning_rate)
 
         def step_one(p, m, v):

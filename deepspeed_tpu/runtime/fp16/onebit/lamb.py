@@ -41,8 +41,9 @@ def onebit_lamb(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         return inner.init(params)
 
     def update(grads, state, params):
+        prev_count = state.count  # pre-increment: optax schedules are 0-based
         raw_updates, state = inner.update(grads, state, params)
-        lr = (learning_rate(state.count) if callable(learning_rate)
+        lr = (learning_rate(prev_count) if callable(learning_rate)
               else learning_rate)
 
         def scale_one(p, u):
@@ -125,8 +126,8 @@ def zero_one_adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
         # v sees one update per refresh (steps 1, P, 2P, ...); count them
         n_refresh = (1 + count // var_update_period).astype(jnp.float32)
         bias2 = 1 - b2 ** n_refresh
-        lr = (learning_rate(count) if callable(learning_rate)
-              else learning_rate)
+        lr = (learning_rate(state.count) if callable(learning_rate)
+              else learning_rate)  # pre-increment: optax schedules are 0-based
 
         def step_one(p, m, v):
             denom = jnp.sqrt(v / bias2) + eps

@@ -1,0 +1,90 @@
+"""Expert-sharded checkpoint format.
+
+Reference parity: ``engine.py:2965 _save_moe_checkpoint`` writes each MoE
+layer's experts to their own ``layer_#_expert_#`` files so no rank ever
+gathers the full expert set, and pops expert keys from the dense model
+states (``:2960``). Here the engine's params are ONE logical SPMD tree, so
+the split is by leaf slice instead of by module walk: every
+:class:`~deepspeed_tpu.moe.experts.StackedExperts` leaf is sliced along its
+expert axis and each global expert id gets its own file. On a real pod a
+``device_get`` of one expert slice only pulls that expert's ``ep`` shard —
+the full expert set never materializes on one host. Optimizer moments that
+mirror expert params (optax mu/nu subtrees end with the param path) split
+the same way.
+
+Pyramid/Residual MoE (different expert counts per layer, reference
+PR-MoE) is supported: a leaf contributes to expert file ``e`` only while
+``e < its own expert count``.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.moe.layer import expert_axis
+from deepspeed_tpu.utils.tree import flatten_dots, unflatten_dots
+
+
+def find_expert_leaves(sd: Dict[str, Any]) -> Dict[str, int]:
+    """{dotted_path: expert_axis} for every expert leaf in a state dict
+    (params or optimizer state — optax mu/nu paths END with the param
+    path, so the suffix match applies to both)."""
+    out = {}
+    for p, leaf in flatten_dots(sd).items():
+        ax = expert_axis(p.replace(".", "/"), getattr(leaf, "ndim", 0))
+        if ax is not None:
+            out[p] = ax
+    return out
+
+
+def split_expert_sd(sd: Dict[str, Any], expert_info: Dict[str, int]
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any], int]:
+    """State dict -> (dense_sd_without_experts, meta, num_expert_files).
+
+    ``meta`` records each expert leaf's axis and expert count so the loader
+    can re-stack without guessing.
+    """
+    # keep_empty_nodes: optax chain states contain EmptyState leaves that
+    # a plain flatten would silently drop, breaking from_state_dict
+    flat = flatten_dots(sd, keep_empty_nodes=True)
+    counts = {p: int(flat[p].shape[ax]) for p, ax in expert_info.items()}
+    for p in expert_info:
+        flat.pop(p)
+    meta = {"axes": dict(expert_info), "counts": counts}
+    return unflatten_dots(flat), meta, max(counts.values())
+
+
+def expert_slice(expert_leaves: Dict[str, Any], expert_info: Dict[str, int],
+                 e: int) -> Dict[str, np.ndarray]:
+    """One global expert id's slice of every expert leaf that has it.
+    ``expert_leaves`` maps dotted path -> full leaf (flatten ONCE in the
+    caller — a 64-expert save must not re-flatten the multi-GB tree per
+    file). The ``jnp.take`` + host fetch per slice keeps the transfer to
+    one expert's shard instead of the whole stack."""
+    out = {}
+    for p, ax in expert_info.items():
+        leaf = expert_leaves[p]
+        if e < leaf.shape[ax]:
+            out[p] = np.asarray(jnp.take(leaf, e, axis=ax))
+    return out
+
+
+def merge_expert_slices(dense_sd: Dict[str, Any], meta: Dict[str, Any],
+                        slices_by_expert: Dict[int, Dict[str, np.ndarray]]
+                        ) -> Dict[str, Any]:
+    """Inverse of the split: re-stack per-expert slices into full leaves
+    and merge them back into the dense state dict."""
+    flat = flatten_dots(dense_sd, keep_empty_nodes=True)
+    for p, ax in meta["axes"].items():
+        n = int(meta["counts"][p])
+        stacked = np.stack(
+            [slices_by_expert[e][p] for e in range(n)], axis=int(ax))
+        flat[p] = stacked
+    return unflatten_dots(flat)
+
+
+def expert_states_filename(e: int, kind: str = "model") -> str:
+    """Reference-flavored naming (engine.py _get_expert_ckpt_name uses
+    ``..._expert_{id}_mp_rank_00_model_states.pt``)."""
+    return f"expert_{e}_mp_rank_00_{kind}_states.msgpack"

@@ -90,12 +90,15 @@ class HostOffloadOptimizer:
 
     # ------------------------------------------------------------------
     def step(self, acc_grads, loss_scale: float = 1.0,
-             global_step: int = 0, current_params=None):
+             global_step: int = 0, current_params=None, lr_override=None):
         """Host optimizer step. Returns (new device params tree, overflow,
         grad_norm). On overflow the masters are untouched and
         ``current_params`` (when given) is returned as-is — no redundant
-        full-model re-upload."""
-        if self.lr_schedule is not None:
+        full-model re-upload. ``lr_override``: absolute lr for this step
+        (write-through param_groups["lr"], engine.set_lr)."""
+        if lr_override is not None:
+            self.cpu_adam.lr = float(lr_override)
+        elif self.lr_schedule is not None:
             self.cpu_adam.lr = float(self.lr_schedule(global_step))
 
         host_grads = jax.device_get(acc_grads)

@@ -277,3 +277,82 @@ def test_global_grad_norm_exposed(eight_devices):
     engine.train_batch(iter(RepeatingLoader(loader)))
     gn = engine.get_global_grad_norm()
     assert gn is not None and np.isfinite(gn) and gn > 0
+
+
+def test_param_groups_no_adam_defaults_for_sgd(eight_devices):
+    """An SGD config must not report fabricated Adam hyperparameters
+    (betas/eps) — only the keys its own family has."""
+    _, opt, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "SGD",
+                              "params": {"lr": 1e-2, "momentum": 0.9}},
+                "steps_per_print": 10 ** 9})
+    g = opt.param_groups[0]
+    assert "betas" not in g and "eps" not in g, g
+    assert g["momentum"] == pytest.approx(0.9)
+    assert g["lr"] == pytest.approx(1e-2)
+
+
+def test_param_groups_lr_write_through(eight_devices):
+    """Assigning param_groups[0]["lr"] must change the lr the NEXT compiled
+    step applies (reference torch-optim mutation surface), without
+    recompiling."""
+    engine, opt, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "SGD", "params": {"lr": 0.1}},
+                "steps_per_print": 10 ** 9},
+        training_data=random_dataset(64))
+    loader = iter(RepeatingLoader(engine.deepspeed_io(random_dataset(64))))
+    engine.train_batch(loader)
+    p1 = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree.leaves(engine.params)])
+
+    opt.param_groups[0]["lr"] = 0.0  # freeze: SGD updates are -lr * g
+    assert engine.get_lr() == [0.0]
+    engine.train_batch(loader)
+    p2 = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree.leaves(engine.params)])
+    np.testing.assert_array_equal(p1, p2)
+
+    opt.param_groups[0]["lr"] = 0.1  # thaw: params move again
+    engine.train_batch(loader)
+    p3 = np.concatenate([np.asarray(x).ravel()
+                         for x in jax.tree.leaves(engine.params)])
+    assert np.abs(p3 - p2).max() > 0.0
+
+
+def test_lr_override_cleared_by_scheduler(eight_devices):
+    """Torch parity: with an active lr scheduler a manual lr set lasts one
+    step — scheduler.step() re-asserts the schedule."""
+    engine, opt, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_min_lr": 1e-4,
+                                         "warmup_max_lr": 1e-3,
+                                         "warmup_num_steps": 10}},
+                "steps_per_print": 10 ** 9})
+    loader = iter(RepeatingLoader(engine.deepspeed_io(random_dataset(64))))
+    engine.train_batch(loader)
+    opt.param_groups[0]["lr"] = 5e-2
+    assert engine.get_lr() == [5e-2]
+    engine.train_batch(loader)  # uses the override, then scheduler wins
+    assert engine._lr_override is None
+    assert engine.get_lr() != [5e-2]
+
+
+def test_client_optimizer_lr_write_raises(eight_devices):
+    """With a client optax optimizer the engine cannot redirect lr —
+    the write must raise instead of silently doing nothing."""
+    import optax
+
+    engine, opt, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        optimizer=optax.adamw(1e-3),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "steps_per_print": 10 ** 9})
+    with pytest.raises(NotImplementedError):
+        opt.param_groups[0]["lr"] = 1e-4

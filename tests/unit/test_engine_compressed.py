@@ -52,7 +52,8 @@ def _engine(opt_block, extra=None, micro=8, gas=1):
 def _compiled_step_text(engine, batch):
     lowered = engine._train_step_fn.lower(
         engine._params, engine._opt_state, engine._ls_state,
-        engine._put_batch(batch), engine._rng, engine.micro_steps)
+        engine._put_batch(batch), engine._rng, engine.micro_steps,
+        engine._lr_factor_now())
     return lowered.compile().as_text()
 
 
@@ -157,10 +158,7 @@ class TestOnebitEngine:
             for x in jax.tree.leaves(eng2._opt_state.worker_error)])
         assert we.max() > 0
 
-    def test_rejects_fp16_and_zero2_and_tp(self, eight_devices):
-        with pytest.raises(ValueError, match="fp16"):
-            _engine({"type": "OnebitAdam", "params": {"lr": 1e-2}},
-                    extra={"fp16": {"enabled": True}})
+    def test_rejects_zero2_and_tp(self, eight_devices):
         with pytest.raises(ValueError, match="ZeRO stage"):
             _engine({"type": "OnebitAdam", "params": {"lr": 1e-2}},
                     extra={"zero_optimization": {"stage": 2}})
@@ -200,3 +198,105 @@ class TestInt8GradComm:
             _engine({"type": "AdamW", "params": {"lr": 1e-2}},
                     extra={"communication_data_type": "int8",
                            "zero_optimization": {"stage": 1}})
+
+
+class TestCompressedObservability:
+    def test_int8_grad_norm_and_clipping(self, eight_devices):
+        """The int8 path materializes the post-exchange mean anyway, so
+        get_global_grad_norm() works and gradient_clipping clips exactly."""
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                      extra={"communication_data_type": "int8",
+                             "gradient_clipping": 1.0})
+        it = iter(RepeatingLoader([batch]))
+        eng.train_batch(it)
+        gn = eng.get_global_grad_norm()
+        assert gn is not None and np.isfinite(gn) and gn > 0, gn
+
+    def test_onebit_norm_gated(self, eight_devices):
+        """1-bit optimizers: grad norm is None by default (the averaged
+        gradient never exists) and real with tpu.compressed_grad_norm."""
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine({"type": "OnebitAdam",
+                       "params": {"lr": 1e-2, "freeze_step": 2}})
+        it = iter(RepeatingLoader([batch]))
+        eng.train_batch(it)
+        assert eng.get_global_grad_norm() is None
+
+        eng2 = _engine({"type": "OnebitAdam",
+                        "params": {"lr": 1e-2, "freeze_step": 2}},
+                       extra={"tpu": {"compressed_grad_norm": True}})
+        it2 = iter(RepeatingLoader([batch]))
+        eng2.train_batch(it2)
+        gn = eng2.get_global_grad_norm()
+        assert gn is not None and np.isfinite(gn) and gn > 0, gn
+
+
+class TestFp16Onebit:
+    def test_overflow_skips_and_keeps_error_feedback(self, eight_devices):
+        """fp16 dynamic loss scaling composes with OnebitAdam (reference
+        fp16/onebit/adam.py pairs them): an overflow step is skipped with
+        params, optimizer count, AND error-feedback buffers untouched, and
+        convergence resumes after the skip."""
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine(
+            {"type": "OnebitAdam", "params": {"lr": 5e-2, "freeze_step": 5}},
+            extra={"fp16": {"enabled": True, "initial_scale_power": 4,
+                            "hysteresis": 1},
+                   "scheduler": {"type": "WarmupDecayLR",
+                                 "params": {"warmup_min_lr": 0,
+                                            "warmup_max_lr": 5e-2,
+                                            "warmup_num_steps": 10,
+                                            "total_num_steps": 200}}})
+        it = iter(RepeatingLoader([batch]))
+        first = float(eng.train_batch(it))
+        for _ in range(19):  # well into the compression stage
+            eng.train_batch(it)
+        assert eng.skipped_steps == 0
+        params_before = [np.asarray(x) for x in jax.tree.leaves(eng.params)]
+        we_before = [np.asarray(x) for x in
+                     jax.tree.leaves(eng._opt_state.worker_error)]
+        count_before = int(eng._opt_state.count)
+
+        bad = {"x": np.full_like(X, np.inf), "y": Y}
+        eng.train_batch(iter(RepeatingLoader([bad])))
+        assert eng.skipped_steps == 1
+        assert eng.loss_scale == 2.0 ** 3  # halved
+        for b, a in zip(params_before, jax.tree.leaves(eng.params)):
+            np.testing.assert_array_equal(b, np.asarray(a))
+        for b, a in zip(we_before,
+                        jax.tree.leaves(eng._opt_state.worker_error)):
+            np.testing.assert_array_equal(b, np.asarray(a))
+        assert int(eng._opt_state.count) == count_before
+
+        for _ in range(160):
+            last = float(eng.train_batch(it))
+        assert last < 0.05 * first, (first, last)
+
+    def test_fp16_int8_comm_overflow_skip(self, eight_devices):
+        """fp16 also composes with communication_data_type=int8: overflow
+        skips the exchange and the server/worker residuals are untouched."""
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine(
+            {"type": "AdamW", "params": {"lr": 5e-2}},
+            extra={"communication_data_type": "int8",
+                   "fp16": {"enabled": True, "initial_scale_power": 4,
+                            "hysteresis": 1}})
+        it = iter(RepeatingLoader([batch]))
+        for _ in range(5):
+            eng.train_batch(it)
+        err_before = [np.asarray(x) for x in jax.tree.leaves(
+            eng._opt_state[1])]
+        bad = {"x": np.full_like(X, np.inf), "y": Y}
+        eng.train_batch(iter(RepeatingLoader([bad])))
+        assert eng.skipped_steps == 1
+        for b, a in zip(err_before, jax.tree.leaves(eng._opt_state[1])):
+            np.testing.assert_array_equal(b, np.asarray(a))
+        last = None
+        for _ in range(60):
+            last = float(eng.train_batch(it))
+        assert np.isfinite(last)

@@ -124,3 +124,34 @@ class TestOnebitAdam:
         assert st13.server_error["w"].shape == (2,)
         with pytest.raises(ValueError):
             onebit_adam(1e-2).init(params)  # axis_size required
+
+
+class TestScheduleIndexing:
+    def test_schedule_sampled_at_zero_on_first_step(self, eight_devices):
+        """Callable lr schedules are 0-based like every optax
+        transformation: the first update must sample the schedule at
+        count=0, so a compressed run sees the same warmup point as the
+        same config uncompressed."""
+        mesh = dp_mesh()
+        # lr 0.5 ONLY at schedule step 0 — a 1-based off-by-one reads 0.0
+        sched = lambda c: jnp.where(c == 0, 0.5, 0.0)  # noqa: E731
+        tx = onebit_adam(sched, warmup_steps=10, axis="dp", axis_size=8)
+        params = {"w": jnp.ones(16)}
+        state = tx.init(params)
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(), jax.tree.map(lambda _: P(), state), P("dp", None)),
+            out_specs=(P(), jax.tree.map(lambda _: P(), state)),
+            check_vma=False)
+        def step(params, state, g):
+            updates, state = tx.update({"w": g[0]}, state, params)
+            return updates, state
+
+        g = jnp.ones((8, 16), jnp.float32)
+        upd1, state = step(params, state, g)
+        assert float(jnp.abs(upd1["w"]).max()) > 0.0, \
+            "first step sampled the schedule past index 0"
+        upd2, state = step(params, state, g)
+        assert float(jnp.abs(upd2["w"]).max()) == 0.0, \
+            "second step must sample the schedule at index 1"

@@ -1,0 +1,130 @@
+"""ZeRO-Infinity parameter NVMe tier (runtime/zero/param_nvme.py).
+
+Reference parity: swap_tensor/partitioned_param_swapper.py:35 +
+partition_parameters.py:537 remote_device="nvme" — parameters, masters,
+and moments live on SSD; host RAM holds a rotating layer window.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.pipeline_gpt import gpt_pipeline
+from deepspeed_tpu.models.transformer_lm import GPTConfig
+
+
+def _engine(tmp_path, n_layer=4, **cfg_over):
+    cfg = GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                    n_layer=n_layer, n_head=4, dtype=jnp.float32,
+                    scan_layers=False, dropout=0.0, **cfg_over)
+    ds = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {
+            "offload_param": {"device": "nvme", "nvme_path": str(tmp_path)}},
+        "steps_per_print": 10 ** 9,
+    }
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt_pipeline(cfg, num_stages=1), config=ds)
+    return eng
+
+
+def _batch(seed=0, bs=8):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, 128, size=(bs, 32)).astype(np.int32)
+    return {"input_ids": ids, "labels": ids}
+
+
+class TestNVMeParamTier:
+    def test_trains_and_swap_files_on_disk(self, tmp_path):
+        eng = _engine(tmp_path)
+        batch = _batch()
+        losses = [float(eng.train_batch(iter([batch]))) for _ in range(8)]
+        assert losses[-1] < 0.8 * losses[0], losses
+        files = os.listdir(os.path.join(str(tmp_path), "param_nvme"))
+        # 4 streamed layers x (compute, master, m, v)
+        assert len([f for f in files if f.startswith("c")]) == 4
+        assert len([f for f in files if f.startswith("p")]) == 4
+        assert len([f for f in files if f.startswith("m")]) == 4
+        assert len([f for f in files if f.startswith("v")]) == 4
+
+    def test_layer_sweep_grads_match_end_to_end(self, tmp_path):
+        """The chained per-layer recompute-vjp must produce the SAME
+        gradients as jax.grad of the composed model — the correctness core
+        of the sweep."""
+        eng = _engine(tmp_path, n_layer=2)
+        batch = _batch()
+        eng._init_state(batch)
+
+        # materialize every layer's params from the store
+        params = [jax.device_get(eng._embed_params)]
+        for li in range(eng._n_stream):
+            flat = eng.store.get(f"p{li}")
+            params.append(jax.device_get(eng._unflatten(flat, li + 1)))
+            eng.store.write(f"p{li}", flat)
+        params.append(jax.device_get(eng._head_params))
+        eng.store.barrier()
+
+        ids = jnp.asarray(batch["input_ids"])
+        labels = jnp.asarray(batch["labels"])
+        mods, loss_fn = eng._mods, eng.module.loss_fn
+
+        def composed(ps):
+            x = ids
+            for mod, p in zip(mods, ps):
+                x = mod.apply({"params": p}, x, deterministic=True)
+            return loss_fn(x, labels)
+
+        ref_grads = jax.grad(composed)(params)
+
+        # capture the grads the sweep feeds the host optimizer
+        got = {}
+        orig = eng.cpu_adam.update_tensor
+
+        def spy(p, g, m, v):
+            got[len(got)] = np.array(g, copy=True)
+            return orig(p, g, m, v)
+
+        eng.cpu_adam.update_tensor = spy
+        eng.train_batch(iter([batch]))
+
+        # order of updates: head, streamed layers reversed, embed
+        def flat(tree):
+            return np.concatenate([
+                np.asarray(l, np.float32).ravel()
+                for l in jax.tree.leaves(tree)])
+
+        order = ([len(params) - 1]
+                 + list(reversed(range(1, len(params) - 1))) + [0])
+        for slot, pi in enumerate(order):
+            np.testing.assert_allclose(
+                got[slot], flat(ref_grads[pi]), rtol=2e-4, atol=2e-5,
+                err_msg=f"layer {pi}")
+
+    def test_deterministic_across_runs(self, tmp_path):
+        l1 = [float(_engine(tmp_path / "a").train_batch(iter([_batch()])))
+              for _ in range(1)]
+        l2 = [float(_engine(tmp_path / "b").train_batch(iter([_batch()])))
+              for _ in range(1)]
+        assert l1 == l2
+
+    def test_rejects_gas(self, tmp_path):
+        cfg = GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                        n_layer=2, n_head=4, dtype=jnp.float32,
+                        scan_layers=False, dropout=0.0)
+        ds = {
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {
+                "offload_param": {"device": "nvme",
+                                  "nvme_path": str(tmp_path)}},
+            "steps_per_print": 10 ** 9,
+        }
+        with pytest.raises(NotImplementedError, match="accumulation"):
+            deepspeed_tpu.initialize(
+                model=gpt_pipeline(cfg, num_stages=1), config=ds)
