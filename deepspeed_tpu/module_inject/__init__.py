@@ -53,4 +53,9 @@ def __getattr__(name):
         from deepspeed_tpu.module_inject import hf
 
         return getattr(hf, name)
+    # diffusers UNet policy (state-dict level; reference replace_policy.py:30)
+    if name in ("unet_from_sd", "unet_attention_from_sd", "DSUNetAttention"):
+        from deepspeed_tpu.module_inject import unet
+
+        return getattr(unet, name)
     raise AttributeError(name)

@@ -1,0 +1,152 @@
+"""Diffusers UNet injection policy as a state-dict converter.
+
+Reference parity: ``module_inject/replace_policy.py:30`` (UNetPolicy) fuses
+the q/k/v projections of every attention block inside a diffusers
+``UNet2DConditionModel`` for the fused inference kernels, and
+``model_implementations/diffusers/unet.py`` (DSUNet) wraps the whole model
+for CUDA-graph replay.
+
+TPU re-design: ``diffusers`` is not importable in this environment, so —
+exactly like ``megatron_gpt_from_sd`` (hf.py) does for Megatron — the policy
+consumes the CHECKPOINT layout rather than walking live torch modules: it
+scans a diffusers-format state dict for attention blocks
+(``*.to_q/.to_k/.to_v/.to_out.0``), fuses each into the layout
+:class:`DSUNetAttention` consumes (one qkv matmul for self-attention — the
+reference policy's first branch — or q + fused kv for cross-attention, its
+second branch), and returns flax modules + params. The CUDA-graph wrapper
+needs no counterpart: ``jax.jit`` IS the graph capture on TPU
+(docs/DIVERGENCES.md).
+"""
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _np(x) -> np.ndarray:
+    """torch tensor / array-like -> float32 numpy (no torch import needed)."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+class DSUNetAttention(nn.Module):
+    """Fused (cross-)attention block matching diffusers ``CrossAttention``
+    semantics: no q/k/v bias, ``softmax(q k^T / sqrt(d)) v``, biased output
+    projection. Self-attention runs one fused qkv matmul (reference
+    UNetPolicy.attention branch 1, replace_policy.py:47); cross-attention
+    fuses k and v over the context (branch 2 keeps them separate — one
+    matmul fewer here)."""
+
+    heads: int
+    inner_dim: int           # heads * dim_head
+    out_dim: int             # query_dim (to_out output features)
+    self_attention: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, context=None):
+        if self.self_attention:
+            assert context is None, "self-attention block got a context"
+            qkv = nn.Dense(3 * self.inner_dim, use_bias=False,
+                           dtype=self.dtype, name="to_qkv")(hidden)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            ctx = hidden if context is None else context
+            q = nn.Dense(self.inner_dim, use_bias=False, dtype=self.dtype,
+                         name="to_q")(hidden)
+            kv = nn.Dense(2 * self.inner_dim, use_bias=False,
+                          dtype=self.dtype, name="to_kv")(ctx)
+            k, v = jnp.split(kv, 2, axis=-1)
+
+        B, N, _ = q.shape
+        M = k.shape[1]
+        d = self.inner_dim // self.heads
+        q = q.reshape(B, N, self.heads, d)
+        k = k.reshape(B, M, self.heads, d)
+        v = v.reshape(B, M, self.heads, d)
+        scores = jnp.einsum("bnhd,bmhd->bhnm", q, k) * (d ** -0.5)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhnm,bmhd->bnhd", probs.astype(v.dtype), v)
+        out = out.reshape(B, N, self.inner_dim)
+        return nn.Dense(self.out_dim, use_bias=True, dtype=self.dtype,
+                        name="to_out")(out)
+
+
+def unet_attention_from_sd(sd: Dict[str, Any], prefix: str, heads: int,
+                           dtype=jnp.float32
+                           ) -> Tuple[DSUNetAttention, Dict[str, Any]]:
+    """One attention block's weights -> ``(DSUNetAttention, params)``.
+
+    ``prefix`` addresses the block (e.g.
+    ``down_blocks.0.attentions.0.transformer_blocks.0.attn1``); ``heads``
+    comes from the model config, exactly as the reference policy reads
+    ``client_module.heads`` (replace_policy.py:56) — a state dict alone
+    does not record it.
+    """
+    qw = _np(sd[f"{prefix}.to_q.weight"])          # torch [inner, q_dim]
+    kw = _np(sd[f"{prefix}.to_k.weight"])          # torch [inner, ctx_dim]
+    vw = _np(sd[f"{prefix}.to_v.weight"])
+    ow = _np(sd[f"{prefix}.to_out.0.weight"])      # torch [q_dim, inner]
+    ob = _np(sd[f"{prefix}.to_out.0.bias"])
+    inner = qw.shape[0]
+    if inner % heads:
+        raise ValueError(
+            f"{prefix}: inner dim {inner} not divisible by heads={heads}")
+    # diffusers naming is authoritative (attn1 = self, attn2 = cross): a
+    # UNet whose cross_attention_dim equals the block width would fool the
+    # shape heuristic the reference policy uses, and a fused-qkv module
+    # cannot accept a context at inference. Shapes are the fallback for
+    # nonstandard prefixes.
+    if prefix.endswith(".attn1"):
+        self_attn = True
+    elif prefix.endswith(".attn2"):
+        self_attn = False
+    else:
+        self_attn = qw.shape[1] == kw.shape[1]
+    if self_attn and qw.shape[1] != kw.shape[1]:
+        raise ValueError(
+            f"{prefix}: named self-attention but q/k input dims differ "
+            f"({qw.shape[1]} vs {kw.shape[1]})")
+
+    out_p = {"kernel": ow.T, "bias": ob}
+    if self_attn:
+        params = {
+            "to_qkv": {"kernel": np.concatenate([qw, kw, vw], axis=0).T},
+            "to_out": out_p,
+        }
+    else:
+        params = {
+            "to_q": {"kernel": qw.T},
+            "to_kv": {"kernel": np.concatenate([kw, vw], axis=0).T},
+            "to_out": out_p,
+        }
+    module = DSUNetAttention(
+        heads=heads, inner_dim=inner, out_dim=ow.shape[0],
+        self_attention=self_attn, dtype=dtype)
+    return module, params
+
+
+def unet_from_sd(sd: Dict[str, Any], heads: int, dtype=jnp.float32
+                 ) -> Dict[str, Tuple[DSUNetAttention, Dict[str, Any]]]:
+    """Scan a diffusers UNet state dict and convert EVERY attention block
+    (the modules the reference UNetPolicy targets; the conv backbone stays
+    with its source runtime). Returns ``{block_prefix: (module, params)}``.
+
+    ``heads`` may be an int (uniform, SD-1.x style) or a callable
+    ``prefix -> int`` for UNets with per-resolution head counts.
+    """
+    prefixes = sorted(
+        k[: -len(".to_q.weight")] for k in sd if k.endswith(".to_q.weight"))
+    if not prefixes:
+        raise ValueError(
+            "no attention blocks (*.to_q.weight) found: not a diffusers "
+            "UNet-style state dict")
+    get_heads = heads if callable(heads) else (lambda _p: heads)
+    return {
+        p: unet_attention_from_sd(sd, p, get_heads(p), dtype=dtype)
+        for p in prefixes
+    }
