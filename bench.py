@@ -26,6 +26,14 @@ sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 from benchmarks import bert_pretrain, gpt_pretrain  # noqa: E402
 
+# peak dense bf16 on one v5e chip (public spec, 197 TFLOPS) — the honest
+# denominator: MFU = achieved model TFLOPS / peak. The A100 fleet the
+# reference reports against runs ~157/312 = 50% MFU at the same scale, so
+# matching MFU is the apples-to-apples "matches the reference" claim;
+# vs_baseline keeps the reference's own published number as denominator
+# and vs_baseline_metric names exactly which number that is.
+PEAK_BF16_TFLOPS = 197.0
+
 
 def main():
     r = bert_pretrain.run("bert-large", seq=128, micro=64, remat=True,
@@ -34,8 +42,12 @@ def main():
         "metric": "bert_large_seq128_train_tflops_per_chip",
         "value": r["model_tflops"],
         "unit": "TFLOPS",
+        "mfu": round(r["model_tflops"] / PEAK_BF16_TFLOPS, 3),
         "vs_baseline": round(
             r["model_tflops"] / bert_pretrain.BASELINE_TFLOPS, 3),
+        "vs_baseline_metric": "reference headline 64 TFLOPS on one V100 "
+                              "(docs/_posts/2020-05-28-fastest-bert-"
+                              "training.md)",
         "samples_per_sec": r["samples_per_sec"],
         "samples_per_sec_vs_baseline": round(
             r["samples_per_sec"] / bert_pretrain.BASELINE_SAMPLES_SEC, 3),
@@ -56,8 +68,13 @@ def main():
         "metric": "gpt2_1.3b_seq1024_train_tflops_per_chip",
         "value": g["model_tflops"],
         "unit": "TFLOPS",
+        "mfu": round(g["model_tflops"] / PEAK_BF16_TFLOPS, 3),
+        "mfu_reference_a100_fleet": 0.50,  # 157/312 published A100 MFU
         "vs_baseline": round(
             g["model_tflops"] / gpt_pretrain.BASELINE_TFLOPS, 3),
+        "vs_baseline_metric": "ZeRO-Offload single-V100 30 TFLOPS "
+                              "(docs/_pages/training.md:293) — an OFFLOAD "
+                              "config; the honest comparison is MFU",
         "samples_per_sec": g["samples_per_sec"],
         "ms_per_step": g["ms_per_step"],
         "seq_len": g["seq"],
