@@ -127,7 +127,9 @@ def initialize(
             if training_data is not None or lr_scheduler is not None:
                 raise NotImplementedError(
                     "offload_param nvme tier: pass batches to train_batch "
-                    "directly (no dataloader/scheduler wiring yet)")
+                    "directly, and configure lr schedules via the config "
+                    "'scheduler' block (client scheduler objects and "
+                    "dataloader wiring are not supported here)")
             from deepspeed_tpu.runtime.zero.param_nvme import NVMeParamEngine
 
             engine = NVMeParamEngine(module=model, config=cfg_obj, seed=seed)
@@ -1167,8 +1169,14 @@ class DeepSpeedEngine:
                 and self.gradient_accumulation_steps > 1):
             # streamed-param mode replaces the grad tree each micro step;
             # accumulate host-side f32 (the host optimizer consumes numpy
-            # grads anyway, and each micro grad is already scaled by 1/gas)
-            leaves = jax.tree.leaves(jax.device_get(self._acc_grads))
+            # grads anyway, and each micro grad is already scaled by 1/gas).
+            # Kick off ALL device->host copies before consuming any so the
+            # transfers pipeline instead of serializing leaf by leaf.
+            dev_leaves = jax.tree.leaves(self._acc_grads)
+            for leaf in dev_leaves:
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            leaves = [np.asarray(leaf) for leaf in dev_leaves]
             if self._host_grad_acc is None:
                 self._host_grad_acc = [
                     np.asarray(l, np.float32).copy() for l in leaves]

@@ -97,10 +97,14 @@ class NVMeParamEngine:
                 "offload_param nvme tier runs the fused host Adam "
                 f"(reference DeepSpeedCPUAdam); optimizer type "
                 f"{config.optimizer.type!r} is not supported here")
+        self._lr_schedule = None
         if config.scheduler.type is not None:
-            raise NotImplementedError(
-                "offload_param nvme tier: lr schedulers are not wired into "
-                "the host Adam yet; set a constant lr")
+            from deepspeed_tpu.runtime.lr_schedules import (
+                schedule_fn_from_config,
+            )
+
+            self._lr_schedule = schedule_fn_from_config(
+                config.scheduler.type, config.scheduler.params)
         opt_p = dict(config.optimizer.params or {})
         betas = opt_p.get("betas", (0.9, 0.999))
         self.cpu_adam = DeepSpeedCPUAdam(
@@ -164,6 +168,11 @@ class NVMeParamEngine:
                 self.store.write(f"c{li}", self._to_compute(flat, li))
                 self.store.write(f"m{li}", np.zeros_like(flat))
                 self.store.write(f"v{li}", np.zeros_like(flat))
+                # bound the write backlog: the aio queue holds a ref to
+                # every queued buffer, so an un-barriered init would keep
+                # the WHOLE model in RAM (measured: 8.2 GB RSS for a 4.8 GB
+                # stack) — exactly what this tier exists to avoid
+                self.store.barrier()
                 del params
             else:
                 # resident: device params + host master + host moments
@@ -273,15 +282,20 @@ class NVMeParamEngine:
         acts = []
         self.store.prefetch("c0")
         for li in range(S):
-            if li + 1 < S:
-                self.store.prefetch(f"c{li + 1}")
+            # get BEFORE prefetching the next layer: wait() is global, so
+            # a prefetch queued first would be waited on too — the next
+            # layer's read must instead overlap THIS layer's compute
             p_dev = jax.device_put(self._unflatten(
                 self.store.get(f"c{li}"), li + 1))
+            if li + 1 < S:
+                self.store.prefetch(f"c{li + 1}")
             acts.append(x)
             x = self._block_fwd(li + 1)(p_dev, x)
             del p_dev
 
         # ---- head + loss + its backward (resident) ----
+        if self._lr_schedule is not None:
+            self.cpu_adam.lr = float(self._lr_schedule(self.global_steps))
         self.cpu_adam.step_count += 1  # once per step, before any update
         loss, g_head, gx = self._loss_and_head_bwd()(
             self._head_params, x, labels)
@@ -292,16 +306,16 @@ class NVMeParamEngine:
             for kind in ("c", "p", "m", "v"):
                 self.store.prefetch(f"{kind}{S - 1}")
         for li in reversed(range(S)):
-            if li - 1 >= 0:
-                for kind in ("c", "p", "m", "v"):
-                    self.store.prefetch(f"{kind}{li - 1}")
             p_dev = jax.device_put(self._unflatten(
                 self.store.get(f"c{li}"), li + 1))
-            g_flat, gx = self._block_bwd(li + 1)(p_dev, acts[li], gx)
-            del p_dev
             master = self.store.get(f"p{li}")
             m = self.store.get(f"m{li}")
             v = self.store.get(f"v{li}")
+            if li - 1 >= 0:  # after the gets (global wait, see fwd sweep)
+                for kind in ("c", "p", "m", "v"):
+                    self.store.prefetch(f"{kind}{li - 1}")
+            g_flat, gx = self._block_bwd(li + 1)(p_dev, acts[li], gx)
+            del p_dev
             self.cpu_adam.update_tensor(
                 master, np.asarray(g_flat), m, v)
             self.store.write(f"p{li}", master)
@@ -336,6 +350,74 @@ class NVMeParamEngine:
         # rebuild the device tree from the updated master
         idx = 0 if name == "embed" else len(self._mods) - 1
         st["dev"] = jax.device_put(self._unflatten(st["p"], idx))
+
+    # ------------------------------------------------------------------
+    # checkpointing: the SSD store IS the state — snapshot blobs + the
+    # resident (embed/head) masters + counters (reference nvme checkpoints
+    # likewise persist the swap files' content)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        import pickle
+        import shutil
+
+        assert self._initialized, "cannot checkpoint before first batch"
+        tag = tag or f"global_step{self.global_steps}"
+        out = os.path.join(save_dir, str(tag))
+        os.makedirs(out, exist_ok=True)
+        self.store.barrier()
+        blob_dir = self.store.swapper.swap_dir
+        for f in os.listdir(blob_dir):
+            shutil.copy2(os.path.join(blob_dir, f), os.path.join(out, f))
+        residents = {
+            f"{name}.{k}": st[k]
+            for name, st in self._resident_masters.items()
+            for k in ("p", "m", "v")
+        }
+        np.savez(os.path.join(out, "resident_masters.npz"), **residents)
+        with open(os.path.join(out, "nvme_engine_states.pkl"), "wb") as f:
+            pickle.dump({
+                "global_steps": self.global_steps,
+                "step_count": self.cpu_adam.step_count,
+                "swap_meta": self.store.swapper._meta,
+                "client_state": client_state or {},
+            }, f)
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(str(tag))
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None):
+        import pickle
+        import shutil
+
+        assert self._initialized, (
+            "run one train_batch before load_checkpoint so layer "
+            "templates exist")
+        if tag is None:
+            with open(os.path.join(load_dir, "latest")) as f:
+                tag = f.read().strip()
+        src = os.path.join(load_dir, str(tag))
+        with open(os.path.join(src, "nvme_engine_states.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        self.store.barrier()
+        blob_dir = self.store.swapper.swap_dir
+        for f_ in os.listdir(src):
+            if f_.endswith(".swp"):
+                shutil.copy2(os.path.join(src, f_),
+                             os.path.join(blob_dir, f_))
+        self.store.swapper._meta = dict(meta["swap_meta"])
+        data = np.load(os.path.join(src, "resident_masters.npz"))
+        for name, st in self._resident_masters.items():
+            for k in ("p", "m", "v"):
+                st[k] = np.array(data[f"{name}.{k}"], copy=True)
+            idx = 0 if name == "embed" else len(self._mods) - 1
+            st["dev"] = jax.device_put(self._unflatten(st["p"], idx))
+        if "embed" in self._resident_masters:
+            self._embed_params = self._resident_masters["embed"]["dev"]
+        if "head" in self._resident_masters:
+            self._head_params = self._resident_masters["head"]["dev"]
+        self.global_steps = int(meta["global_steps"])
+        self.cpu_adam.step_count = int(meta["step_count"])
+        return tag, meta.get("client_state", {})
 
     # ------------------------------------------------------------------
     @property

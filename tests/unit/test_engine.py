@@ -356,3 +356,24 @@ def test_client_optimizer_lr_write_raises(eight_devices):
                 "steps_per_print": 10 ** 9})
     with pytest.raises(NotImplementedError):
         opt.param_groups[0]["lr"] = 1e-4
+
+
+def test_lr_write_does_not_recompile(eight_devices):
+    """The lr override rides in as a traced scalar — changing it must not
+    trigger a recompile of the train step."""
+    engine, opt, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "SGD", "params": {"lr": 0.05}},
+                "steps_per_print": 10 ** 9},
+        training_data=random_dataset(64))
+    loader = iter(RepeatingLoader(engine.deepspeed_io(random_dataset(64))))
+    engine.train_batch(loader)
+    engine.train_batch(loader)  # steady state (first->second step retraces
+    fn = engine._train_step_fn  # once on state types, independent of lr)
+    compiles_before = fn._cache_size()
+    for lr in (0.01, 0.002, 0.5):
+        opt.param_groups[0]["lr"] = lr
+        engine.train_batch(loader)
+    assert engine._train_step_fn is fn
+    assert fn._cache_size() == compiles_before

@@ -128,3 +128,42 @@ class TestNVMeParamTier:
         with pytest.raises(NotImplementedError, match="accumulation"):
             deepspeed_tpu.initialize(
                 model=gpt_pipeline(cfg, num_stages=1), config=ds)
+
+
+class TestNVMeCheckpointAndSchedule:
+    def test_checkpoint_roundtrip_resumes_identically(self, tmp_path):
+        eng = _engine(tmp_path / "run")
+        batch = _batch()
+        for _ in range(3):
+            eng.train_batch(iter([batch]))
+        eng.save_checkpoint(str(tmp_path / "ckpt"), tag="t")
+        run1 = [float(eng.train_batch(iter([batch]))) for _ in range(2)]
+        eng.load_checkpoint(str(tmp_path / "ckpt"), tag="t")
+        assert eng.global_steps == 3
+        run2 = [float(eng.train_batch(iter([batch]))) for _ in range(2)]
+        np.testing.assert_allclose(run1, run2, rtol=1e-6)
+
+    def test_lr_schedule_drives_host_adam(self, tmp_path):
+        cfg = GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                        n_layer=2, n_head=4, dtype=jnp.float32,
+                        scan_layers=False, dropout=0.0)
+        ds = {
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_min_lr": 0.0,
+                                     "warmup_max_lr": 1e-3,
+                                     "warmup_num_steps": 10}},
+            "zero_optimization": {
+                "offload_param": {"device": "nvme",
+                                  "nvme_path": str(tmp_path)}},
+            "steps_per_print": 10 ** 9,
+        }
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt_pipeline(cfg, num_stages=1), config=ds)
+        batch = _batch()
+        eng.train_batch(iter([batch]))
+        lr0 = eng.cpu_adam.lr
+        for _ in range(5):
+            eng.train_batch(iter([batch]))
+        assert eng.cpu_adam.lr > lr0  # warmup advanced the host lr
