@@ -10,11 +10,16 @@ trains at 8-16k tokens where dense attention would materialize multi-GB
 
 Prints one JSON line per sequence length: tokens/sec, ms/step, model TFLOPS.
 
-Measured (r2, v5e chip, GPT-2 125M micro 1, selective remat + flash):
-seq 8192 = 47.8 TFLOPS / 172 ms per step — a shape the einsum path
-cannot even COMPILE on this toolchain (the [T, T] backward exceeds the
-compile-side memory limit). 16k/32k still hit the compile limit in other
-ops; beyond 8k per chip is the sequence-parallel axis's job.
+Measured (v5e chip, GPT-2 125M micro 1):
+* seq 8192, flash + selective remat: 47.8 TFLOPS / 172 ms per step (r2)
+  — a shape the einsum path cannot even COMPILE here (the [T, T]
+  backward exceeds the compile-side memory limit).
+* seq 16384, chunked(1024) + full remat: 3.38 s/step, loss 11.34->10.94
+  over 4 steps (r3) — past the flash kernel's 16 MB scoped-VMEM ceiling.
+* seq 32768, chunked(1024): 13.1 s/step, loss 11.33->11.04 (r3), 4x the
+  previous single-chip ceiling. seq 65536 hits the compile-side memory
+  limit at any chunk size; longer contexts are the sequence-parallel
+  axis's job (parallel/sequence.py ring/Ulysses).
 """
 
 import json
@@ -26,7 +31,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from benchmarks._util import gpt_flops_per_token, time_train_steps  # noqa: E402
 
 
-def run(seq: int, micro: int):
+def run(seq: int, micro: int, mode: str = "flash"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -34,9 +39,16 @@ def run(seq: int, micro: int):
     import deepspeed_tpu
     from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config
 
+    # flash: Pallas kernel (fastest, seq <= 8192 on this toolchain — its
+    # VMEM working set hits the 16 MB scoped ceiling at 16k).
+    # chunked: XLA online-softmax scan (ops/chunked_attention.py) — slower
+    # per step but NO length ceiling; full remat keeps the backward's
+    # per-layer recompute bounded.
+    attn = (dict(use_flash_attention=True, remat=True,
+                 remat_policy="selective") if mode == "flash"
+            else dict(attention_chunk=1024, remat=True, remat_policy="full"))
     cfg = gpt2_config("gpt2-125m", n_positions=seq, dtype=jnp.bfloat16,
-                      scan_layers=True, remat=True, remat_policy="selective",
-                      use_flash_attention=True)
+                      scan_layers=True, **attn)
     model = GPT(cfg)
     ds = {"train_micro_batch_size_per_gpu": micro,
           "gradient_accumulation_steps": 1, "bf16": {"enabled": True},
@@ -59,7 +71,7 @@ def run(seq: int, micro: int):
     tokens = micro * seq
     fpt = gpt_flops_per_token(cfg, seq)
     print(json.dumps({
-        "seq": seq, "micro": micro,
+        "seq": seq, "micro": micro, "mode": mode,
         "tokens_per_sec": round(tokens / dt),
         "ms_per_step": round(dt * 1000, 1),
         "model_tflops": round(tokens * fpt / dt / 1e12, 2),
@@ -70,13 +82,14 @@ if __name__ == "__main__":
     import argparse
 
     p = argparse.ArgumentParser()
-    # beyond 4k the current tunneled toolchain's compile service rejects the
-    # fused train step (kernels compile in isolation at 8k+); pass --long to
-    # attempt 8k/16k anyway on a full toolchain
+    # --long adds the seq >= 8k configs: flash to its 8k toolchain ceiling,
+    # chunked attention beyond it (16k/32k measured on one chip; 65k hits
+    # the compile-side memory limit on this toolchain)
     p.add_argument("--long", action="store_true")
     args = p.parse_args()
-    sweep = [(2048, 8), (4096, 4)]
+    sweep = [(2048, 8, "flash"), (4096, 4, "flash")]
     if args.long:
-        sweep += [(8192, 2), (16384, 1)]
-    for seq, micro in sweep:
-        run(seq, micro)
+        sweep += [(8192, 2, "flash"), (16384, 1, "chunked"),
+                  (32768, 1, "chunked")]
+    for seq, micro, mode in sweep:
+        run(seq, micro, mode)

@@ -71,6 +71,12 @@ class GPTConfig:
     # v5e chip — seq 128: 56 vs 45 TFLOPS for XLA; 512: 49 vs 45 flash;
     # 2048: 47 vs 25; 4096: 48 vs 12)
     use_flash_attention: Any = False
+    # chunked online-softmax attention (ops/chunked_attention.py): bounded
+    # O(T * chunk) score memory in plain XLA — the long-context path where
+    # the flash kernel's VMEM ceiling binds (seq > 8192 on the current
+    # toolchain). An int sets the KV chunk size and takes precedence over
+    # the flash path; None disables.
+    attention_chunk: Optional[int] = None
     # ZeRO-Infinity parameter tier (ops/streaming.py): layer-stack params
     # live in host memory; the scan streams one layer into HBM per step.
     # Pair with ds_config zero_optimization.offload_param (engine places
@@ -112,6 +118,12 @@ class GPTConfig:
             raise ValueError(
                 f"use_flash_attention must be True, False or 'auto'; got "
                 f"{self.use_flash_attention!r}")
+        if self.attention_chunk is not None and (
+                not isinstance(self.attention_chunk, int)
+                or self.attention_chunk <= 0):
+            raise ValueError(
+                f"attention_chunk must be a positive int or None; got "
+                f"{self.attention_chunk!r}")
 
     @property
     def head_dim(self) -> int:
@@ -326,6 +338,21 @@ class CausalSelfAttention(nn.Module):
                 y = nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
                              param_dtype=cfg.param_dtype, name="c_proj")(y)
                 return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+
+        # chunked path: same gating as flash (no mask/ALiBi/attn-dropout),
+        # divisibility by the chunk instead of 128-alignment; explicit
+        # opt-in wins over flash
+        if (cfg.attention_chunk and mask is None and not cfg.alibi
+                and (cfg.dropout == 0.0 or deterministic)
+                and T % cfg.attention_chunk == 0 and T > cfg.attention_chunk):
+            from deepspeed_tpu.ops.chunked_attention import chunked_attention
+
+            y = chunked_attention(q, k, v, causal=cfg.causal,
+                                  chunk=cfg.attention_chunk)
+            y = y.reshape(B, T, C)
+            y = nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="c_proj")(y)
+            return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
 
         # flash path needs 128-aligned seq (TPU tile constraint), no padding
         # mask, and no attention dropout (the kernel has none). "auto"

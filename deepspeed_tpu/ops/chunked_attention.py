@@ -1,0 +1,79 @@
+"""Chunked (online-softmax) attention — the XLA-native long-context path.
+
+Reference counterpart: the sparse/flash attention kernels exist to avoid
+materializing the [T, T] score matrix (ops/sparse_attention,
+triton_flash_attn). The Pallas flash kernel here covers seq <= 8192 on
+the current toolchain (its per-head [T, d] VMEM working set hits the
+16 MB scoped-vmem ceiling at 16k). This module removes the length
+ceiling with plain XLA: a ``lax.scan`` over KV chunks carrying the
+online-softmax state (running max m, normalizer l, weighted accumulator
+acc — the Rabe-Staats / flash-attention recurrence), so peak memory is
+O(T * chunk) scores per step instead of O(T^2), and ``jax.checkpoint``
+on the scan body makes the backward recompute each chunk's scores
+instead of saving them (32 chunks x [H, T, chunk] would otherwise be
+saved for the vjp).
+
+Accuracy: softmax statistics accumulate in f32 regardless of the
+compute dtype; the result matches the einsum reference to bf16/f16
+rounding.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def chunked_attention(q, k, v, *, causal: bool = True, chunk: int = 1024):
+    """Attention over ``[B, T, H, D]`` tensors with bounded score memory.
+
+    ``T`` must be divisible by ``chunk`` (pad the sequence; the callers
+    gate on this the same way the flash path gates on 128-alignment).
+    Returns ``[B, T, H, D]`` in ``q``'s dtype.
+    """
+    B, T, H, D = q.shape
+    if T % chunk:
+        raise ValueError(f"seq len {T} not divisible by chunk {chunk}")
+    n_chunks = T // chunk
+    scale = 1.0 / np.sqrt(D)
+    dtype = q.dtype
+
+    # [B, H, T, D] layout keeps the per-chunk contraction MXU-friendly
+    qh = q.transpose(0, 2, 1, 3).astype(dtype)
+    kh = k.transpose(0, 2, 1, 3).astype(dtype)
+    vh = v.transpose(0, 2, 1, 3).astype(dtype)
+    q_pos = jnp.arange(T)
+
+    neg = jnp.float32(jnp.finfo(jnp.float32).min)
+
+    def body(carry, idx):
+        m, l, acc = carry  # [B,H,T], [B,H,T], [B,H,T,D] — all f32
+        start = idx * chunk
+        k_c = lax.dynamic_slice_in_dim(kh, start, chunk, axis=2)
+        v_c = lax.dynamic_slice_in_dim(vh, start, chunk, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, k_c,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = start + jnp.arange(chunk)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, neg)
+        m_c = jnp.max(s, axis=-1)                      # [B,H,T]
+        m_new = jnp.maximum(m, m_c)
+        # exp(neg - m_new) underflows to exactly 0, so fully-masked rows
+        # contribute nothing and l stays 0 until a visible chunk arrives
+        p = jnp.exp(s - m_new[..., None])              # [B,H,T,chunk]
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(dtype), v_c,
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    init = (
+        jnp.full((B, H, T), neg, jnp.float32),
+        jnp.zeros((B, H, T), jnp.float32),
+        jnp.zeros((B, H, T, D), jnp.float32),
+    )
+    (m, l, acc), _ = lax.scan(
+        jax.checkpoint(body), init, jnp.arange(n_chunks))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(dtype).transpose(0, 2, 1, 3)
