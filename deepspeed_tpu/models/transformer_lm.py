@@ -340,15 +340,24 @@ class CausalSelfAttention(nn.Module):
                 return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
 
         # chunked path: same gating as flash (no mask/ALiBi/attn-dropout),
-        # divisibility by the chunk instead of 128-alignment; explicit
-        # opt-in wins over flash
-        if (cfg.attention_chunk and mask is None and not cfg.alibi
+        # divisibility by the chunk instead of 128-alignment. Selected by
+        # explicit attention_chunk (wins over flash) or by "auto" past the
+        # flash kernel's VMEM ceiling (FLASH_MAX_SEQ).
+        auto_chunk = None
+        if cfg.use_flash_attention == "auto" and T > FLASH_MAX_SEQ:
+            # largest standard chunk that divides T (an odd long T still
+            # routes here rather than into the flash VMEM wall)
+            auto_chunk = next(
+                (c for c in (CHUNKED_AUTO_CHUNK, 512, 256, 128)
+                 if T % c == 0), None)
+        eff_chunk = cfg.attention_chunk or auto_chunk
+        if (eff_chunk and mask is None and not cfg.alibi
                 and (cfg.dropout == 0.0 or deterministic)
-                and T % cfg.attention_chunk == 0 and T > cfg.attention_chunk):
+                and T % eff_chunk == 0 and T > eff_chunk):
             from deepspeed_tpu.ops.chunked_attention import chunked_attention
 
             y = chunked_attention(q, k, v, causal=cfg.causal,
-                                  chunk=cfg.attention_chunk)
+                                  chunk=eff_chunk)
             y = y.reshape(B, T, C)
             y = nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="c_proj")(y)
@@ -357,7 +366,10 @@ class CausalSelfAttention(nn.Module):
         # flash path needs 128-aligned seq (TPU tile constraint), no padding
         # mask, and no attention dropout (the kernel has none). "auto"
         # selects by the measured seq-length crossover (see GPTConfig).
-        want_flash = (T >= FLASH_AUTO_MIN_SEQ
+        # "auto" never picks flash past its VMEM ceiling (FLASH_MAX_SEQ) —
+        # an un-chunkable long T falls through to einsum rather than
+        # compiling the kernel into the wall
+        want_flash = (FLASH_AUTO_MIN_SEQ <= T <= FLASH_MAX_SEQ
                       if cfg.use_flash_attention == "auto"
                       else cfg.use_flash_attention)
         use_flash = (want_flash and mask is None
@@ -466,6 +478,11 @@ class Block(nn.Module):
 # (benchmarks/flash_sweep.py, v5e chip): XLA einsum attention wins below
 # this sequence length, the Pallas flash kernel at and above it
 FLASH_AUTO_MIN_SEQ = 512
+# above this, the flash kernel's per-head VMEM working set exceeds the
+# 16 MB scoped-vmem ceiling (measured at 16384); "auto" falls back to the
+# chunked online-softmax path (ops/chunked_attention.py)
+FLASH_MAX_SEQ = 8192
+CHUNKED_AUTO_CHUNK = 1024
 
 
 def alibi_slopes(n_head: int) -> np.ndarray:

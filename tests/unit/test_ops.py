@@ -407,3 +407,47 @@ class TestChunkedAttention:
             chunked_attention(jnp.zeros((1, 100, 2, 8)),
                               jnp.zeros((1, 100, 2, 8)),
                               jnp.zeros((1, 100, 2, 8)), chunk=64)
+
+    def test_auto_selects_chunked_past_flash_ceiling(self, monkeypatch):
+        """use_flash_attention='auto' must route seq > FLASH_MAX_SEQ to the
+        chunked path instead of compiling the flash kernel into its VMEM
+        wall — and keep flash below it. Probed by marking each path."""
+        import importlib
+
+        ca = importlib.import_module("deepspeed_tpu.ops.chunked_attention")
+        # the pallas package re-exports the function, shadowing the
+        # submodule attribute — resolve the module itself
+        fa = importlib.import_module(
+            "deepspeed_tpu.ops.pallas.flash_attention")
+        from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+
+        class Marker(Exception):
+            pass
+
+        def run(seq):
+            monkeypatch.setattr(
+                ca, "chunked_attention",
+                lambda *a, **k: (_ for _ in ()).throw(Marker("chunked")))
+            monkeypatch.setattr(
+                fa, "flash_attention",
+                lambda *a, **k: (_ for _ in ()).throw(Marker("flash")))
+            cfg = GPTConfig(vocab_size=64, n_positions=seq, n_embd=32,
+                            n_layer=1, n_head=4, dtype=jnp.float32,
+                            scan_layers=False, dropout=0.0,
+                            use_flash_attention="auto")
+            m = GPT(cfg)
+            ids = jnp.zeros((1, seq), jnp.int32)
+            try:
+                jax.eval_shape(
+                    lambda r: m.init(r, ids, deterministic=True),
+                    jax.random.PRNGKey(0))
+            except Marker as e:
+                return str(e)
+            return None
+
+        assert run(16384) == "chunked"
+        assert run(1024) == "flash"
+        assert run(256) is None  # below both thresholds
+        # an un-chunkable long T (not divisible by any standard chunk)
+        # must NOT pick flash past its ceiling — einsum fallback
+        assert run(8192 + 192) is None
