@@ -58,6 +58,14 @@ class AsyncTensorSwapper:
     def wait(self) -> None:
         self.handle.wait()
 
+    def remove(self, name: str) -> None:
+        """Drop a blob (file + metadata). Callers must ensure no queued op
+        still targets it (wait() first)."""
+        self._meta.pop(name, None)
+        path = self._path(name)
+        if os.path.exists(path):
+            os.remove(path)
+
     def swapped_names(self):
         return sorted(self._meta)
 

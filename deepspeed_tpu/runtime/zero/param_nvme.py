@@ -116,12 +116,11 @@ class NVMeParamEngine:
         config._resolve_batch_triad(1)  # single-replica layer sweep
         self.train_micro_batch_size_per_gpu = \
             config.train_micro_batch_size_per_gpu
-        self.gradient_accumulation_steps = 1
-        if config.gradient_accumulation_steps != 1:
-            raise NotImplementedError(
-                "offload_param nvme tier: gradient_accumulation_steps must "
-                "be 1 (grads are consumed per layer as they are produced; "
-                "accumulate by raising the micro batch)")
+        # gas > 1 accumulates streamed-layer grads ON DISK ("g" blobs,
+        # read-add-write per micro step) so the RSS bound survives — see
+        # train_batch; resident (embed/head) grads accumulate in RAM
+        self.gradient_accumulation_steps = \
+            config.gradient_accumulation_steps
         self.global_steps = 0
         self._rng = jax.random.PRNGKey(seed)
         self._initialized = False
@@ -270,7 +269,21 @@ class NVMeParamEngine:
 
     # ------------------------------------------------------------------
     def train_batch(self, data_iter):
-        batch = next(data_iter)
+        """One optimizer step = ``gradient_accumulation_steps`` micro
+        sweeps. Streamed-layer grads accumulate ON DISK (``g`` blobs,
+        read-add-write per micro) so host RSS stays window-bounded;
+        the last micro folds the accumulated grad into the fused host
+        Adam in the same pass."""
+        gas = self.gradient_accumulation_steps
+        losses = []
+        for mi in range(gas):
+            losses.append(self._micro_sweep(
+                next(data_iter), first=mi == 0, last=mi == gas - 1,
+                inv_gas=1.0 / gas))
+        self.global_steps += 1
+        return jnp.mean(jnp.stack(losses))
+
+    def _micro_sweep(self, batch, first, last, inv_gas):
         if not self._initialized:
             self._init_state(batch)
         ids = jnp.asarray(batch["input_ids"])
@@ -294,48 +307,68 @@ class NVMeParamEngine:
             del p_dev
 
         # ---- head + loss + its backward (resident) ----
-        if self._lr_schedule is not None:
-            self.cpu_adam.lr = float(self._lr_schedule(self.global_steps))
-        self.cpu_adam.step_count += 1  # once per step, before any update
+        if last:
+            if self._lr_schedule is not None:
+                self.cpu_adam.lr = float(
+                    self._lr_schedule(self.global_steps))
+            self.cpu_adam.step_count += 1  # once per step, pre-update
         loss, g_head, gx = self._loss_and_head_bwd()(
             self._head_params, x, labels)
-        self._update_resident("head", self._head_params, g_head)
+        self._accumulate_resident("head", self._head_params, g_head,
+                                  first, last, inv_gas)
 
-        # ---- backward sweep: reverse prefetch, streamed Adam ----
+        # ---- backward sweep: reverse prefetch; grads to disk, Adam on
+        # the boundary micro ----
+        bwd_kinds = (("c", "p", "m", "v") if last else ("c",)) + \
+            (("g",) if not first else ())
         if S:
-            for kind in ("c", "p", "m", "v"):
+            for kind in bwd_kinds:
                 self.store.prefetch(f"{kind}{S - 1}")
         for li in reversed(range(S)):
             p_dev = jax.device_put(self._unflatten(
                 self.store.get(f"c{li}"), li + 1))
-            master = self.store.get(f"p{li}")
-            m = self.store.get(f"m{li}")
-            v = self.store.get(f"v{li}")
+            fetched = {k: self.store.get(f"{k}{li}")
+                       for k in bwd_kinds if k != "c"}
             if li - 1 >= 0:  # after the gets (global wait, see fwd sweep)
-                for kind in ("c", "p", "m", "v"):
+                for kind in bwd_kinds:
                     self.store.prefetch(f"{kind}{li - 1}")
             g_flat, gx = self._block_bwd(li + 1)(p_dev, acts[li], gx)
             del p_dev
-            self.cpu_adam.update_tensor(
-                master, np.asarray(g_flat), m, v)
-            self.store.write(f"p{li}", master)
-            self.store.write(f"m{li}", m)
-            self.store.write(f"v{li}", v)
-            self.store.write(f"c{li}", self._to_compute(master, li))
-            del master, m, v
+            g = np.asarray(g_flat, np.float32) * inv_gas
+            if "g" in fetched:
+                g = g + fetched["g"]
+            if last:
+                master, m, v = fetched["p"], fetched["m"], fetched["v"]
+                self.cpu_adam.update_tensor(master, g, m, v)
+                self.store.write(f"p{li}", master)
+                self.store.write(f"m{li}", m)
+                self.store.write(f"v{li}", v)
+                self.store.write(f"c{li}", self._to_compute(master, li))
+                del master, m, v
+            else:
+                self.store.write(f"g{li}", g)
+            del g, fetched
         self.store.barrier()
+        if last and not first and S:
+            # the accumulated-grad blobs are dead once folded into Adam —
+            # keep them out of checkpoints and off the disk budget
+            for li in range(S):
+                self.store.swapper.remove(f"g{li}")
 
         g_embed = self._embed_bwd()(self._embed_params, ids, gx)
-        self._update_resident("embed", self._embed_params, g_embed)
-        if "embed" in self._resident_masters:
-            self._embed_params = self._resident_masters["embed"]["dev"]
-        if "head" in self._resident_masters:
-            self._head_params = self._resident_masters["head"]["dev"]
-        self.global_steps += 1
+        self._accumulate_resident("embed", self._embed_params, g_embed,
+                                  first, last, inv_gas)
+        if last:
+            if "embed" in self._resident_masters:
+                self._embed_params = self._resident_masters["embed"]["dev"]
+            if "head" in self._resident_masters:
+                self._head_params = self._resident_masters["head"]["dev"]
         return loss
 
-    def _update_resident(self, name: str, params, grads) -> None:
-        """Host Adam for the device-resident (embed/head) layers."""
+    def _accumulate_resident(self, name: str, params, grads, first, last,
+                             inv_gas) -> None:
+        """RAM-accumulated grads + host Adam on the boundary micro for the
+        device-resident (embed/head) layers."""
         st = self._resident_masters.setdefault(name, {})
         leaves = jax.tree.leaves(params)
         if "p" not in st:
@@ -345,11 +378,16 @@ class NVMeParamEngine:
             st["v"] = np.zeros_like(st["p"])
         g = np.concatenate([
             np.asarray(l, np.float32).ravel()
-            for l in jax.tree.leaves(grads)])
-        self.cpu_adam.update_tensor(st["p"], g, st["m"], st["v"])
-        # rebuild the device tree from the updated master
-        idx = 0 if name == "embed" else len(self._mods) - 1
-        st["dev"] = jax.device_put(self._unflatten(st["p"], idx))
+            for l in jax.tree.leaves(grads)]) * inv_gas
+        if first:
+            st["g"] = g
+        else:
+            st["g"] += g
+        if last:
+            self.cpu_adam.update_tensor(st["p"], st.pop("g"),
+                                        st["m"], st["v"])
+            idx = 0 if name == "embed" else len(self._mods) - 1
+            st["dev"] = jax.device_put(self._unflatten(st["p"], idx))
 
     # ------------------------------------------------------------------
     # checkpointing: the SSD store IS the state — snapshot blobs + the

@@ -112,22 +112,43 @@ class TestNVMeParamTier:
               for _ in range(1)]
         assert l1 == l2
 
-    def test_rejects_gas(self, tmp_path):
-        cfg = GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
-                        n_layer=2, n_head=4, dtype=jnp.float32,
-                        scan_layers=False, dropout=0.0)
-        ds = {
-            "train_micro_batch_size_per_gpu": 4,
-            "gradient_accumulation_steps": 2,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-            "zero_optimization": {
-                "offload_param": {"device": "nvme",
-                                  "nvme_path": str(tmp_path)}},
-            "steps_per_print": 10 ** 9,
-        }
-        with pytest.raises(NotImplementedError, match="accumulation"):
-            deepspeed_tpu.initialize(
+    def test_gas_matches_large_micro(self, tmp_path):
+        """Disk-accumulated gradient windows: gas=2 @ half micro must land
+        on the same params as gas=1 @ full micro after one optimizer
+        step (the grads sum to the same full-batch mean)."""
+        full = _batch(bs=8)
+        halves = [{k: v[:4] for k, v in full.items()},
+                  {k: v[4:] for k, v in full.items()}]
+
+        def run(nvme_dir, gas, batches):
+            cfg = GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                            n_layer=2, n_head=4, dtype=jnp.float32,
+                            scan_layers=False, dropout=0.0)
+            ds = {
+                "train_micro_batch_size_per_gpu": 8 // gas,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "offload_param": {"device": "nvme",
+                                      "nvme_path": str(nvme_dir)}},
+                "steps_per_print": 10 ** 9,
+            }
+            eng, _, _, _ = deepspeed_tpu.initialize(
                 model=gpt_pipeline(cfg, num_stages=1), config=ds)
+            eng.train_batch(iter(batches))
+            eng.store.barrier()
+            masters = [np.array(eng.store.get(f"p{li}"), copy=True)
+                       for li in range(eng._n_stream)]
+            res = {n: s["p"].copy()
+                   for n, s in eng._resident_masters.items()}
+            return masters, res
+
+        m1, r1 = run(tmp_path / "a", 1, [full])
+        m2, r2 = run(tmp_path / "b", 2, halves)
+        for a, b in zip(m1, m2):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=5e-5)
+        for n in r1:
+            np.testing.assert_allclose(r1[n], r2[n], rtol=2e-4, atol=5e-5)
 
 
 class TestNVMeCheckpointAndSchedule:
@@ -167,3 +188,29 @@ class TestNVMeCheckpointAndSchedule:
         for _ in range(5):
             eng.train_batch(iter([batch]))
         assert eng.cpu_adam.lr > lr0  # warmup advanced the host lr
+
+    def test_gas_leaves_no_stale_grad_blobs(self, tmp_path):
+        """Accumulated-grad blobs die on the boundary micro: checkpoints
+        and the disk budget must not carry a dead fp32 model."""
+        import os as _os
+
+        cfg = GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                        n_layer=2, n_head=4, dtype=jnp.float32,
+                        scan_layers=False, dropout=0.0)
+        ds = {
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {
+                "offload_param": {"device": "nvme",
+                                  "nvme_path": str(tmp_path)}},
+            "steps_per_print": 10 ** 9,
+        }
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt_pipeline(cfg, num_stages=1), config=ds)
+        half = _batch(bs=4)
+        eng.train_batch(iter([half, half]))
+        assert not [n for n in eng.store.swapper.swapped_names()
+                    if n.startswith("g")]
+        files = _os.listdir(_os.path.join(str(tmp_path), "param_nvme"))
+        assert not [f for f in files if f.startswith("g")], files
