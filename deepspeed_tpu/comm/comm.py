@@ -180,10 +180,13 @@ def init_distributed(
         # single-host no-op; do NOT latch _initialized so a later call with
         # real coordinator args still performs the rendezvous
         return
-    log_dist(
+    # log_dist is unusable before the rendezvous: it queries
+    # jax.process_index(), which initialises the XLA backend and makes
+    # jax.distributed.initialize fail — use the raw logger here so a
+    # hanging rendezvous still records what it attempted
+    logger.info(
         f"Initializing distributed JAX: coordinator={coordinator_address} "
-        f"procs={num_processes} id={process_id}",
-        ranks=[-1],
+        f"procs={num_processes} id={process_id}"
     )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -192,6 +195,11 @@ def init_distributed(
         **kwargs,
     )
     _initialized = True
+    log_dist(
+        f"Distributed JAX ready: {jax.process_count()} processes, "
+        f"{jax.device_count()} devices",
+        ranks=[-1],
+    )
 
 
 def _env_int(name: str) -> Optional[int]:
