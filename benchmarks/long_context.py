@@ -18,8 +18,11 @@ Measured (v5e chip, GPT-2 125M micro 1):
   over 4 steps (r3) — past the flash kernel's 16 MB scoped-VMEM ceiling.
 * seq 32768, chunked(1024): 13.1 s/step, loss 11.33->11.04 (r3), 4x the
   previous single-chip ceiling. seq 65536 hits the compile-side memory
-  limit at any chunk size; longer contexts are the sequence-parallel
-  axis's job (parallel/sequence.py ring/Ulysses).
+  limit at any chunk size — re-verified with the fused head+CE
+  (fused_head_ce, which removes the 6.4 GB logits slab): the limit is
+  the backward of the 64-iteration nested attention scan itself, not
+  activation memory. Longer contexts are the sequence-parallel axis's
+  job (parallel/sequence.py ring/Ulysses).
 """
 
 import json

@@ -74,3 +74,111 @@ def _ce_vjp_bwd(res, g):
 
 
 softmax_cross_entropy.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused LM-head + cross entropy (never materializes [N, V] logits)
+# ---------------------------------------------------------------------------
+def _n_chunks(n: int, chunk: int) -> int:
+    """Smallest chunk count that divides n with chunks <= ``chunk`` tokens
+    (static shapes: ``chunk`` caps the materialized [chunk, V] slab)."""
+    k = -(-n // max(1, chunk))
+    while n % k:
+        k += 1
+    return k
+
+
+def _head_logits(x_c, w, bias, vocab_major):
+    # [n, E] x [E, V] -> [n, V]   (vocab_major: w is [V, E], tied embedding)
+    dims = ((((1,), (1,)) if vocab_major else ((1,), (0,))), ((), ()))
+    l = jax.lax.dot_general(x_c, w, dims)
+    if bias is not None:
+        l = l + bias.astype(l.dtype)
+    return l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def fused_linear_cross_entropy(vocab_major, chunk, x, w, bias, targets,
+                               weights):
+    """Weighted-mean nll of ``softmax(x @ w + bias)`` WITHOUT ever
+    materializing the [N, V] logits (at GPT-2 scale the logits + their
+    cotangent are the largest activation by far; chunking the token dim
+    bounds head memory to [chunk, V] and lets the saved HBM buy a larger
+    micro batch or a cheaper remat policy).
+
+    Forward and backward scan over token chunks; the backward recomputes
+    each chunk's logits from (x, w) — the same trade ``jax.checkpoint``
+    makes, applied to the one matmul whose output dominates memory. Every
+    logit value is computed by the identical dot tile as the unfused path,
+    so results match ``softmax_cross_entropy`` to bf16 rounding.
+
+    x: [N, E] compute dtype; w: [E, V] ([V, E] when ``vocab_major`` — the
+    tied-embedding layout); targets: [N] int; weights: [N] f32 mask.
+    """
+    loss, _ = _flce_fwd(vocab_major, chunk, x, w, bias, targets, weights)
+    return loss
+
+
+def _flce_fwd(vocab_major, chunk, x, w, bias, targets, weights):
+    n, _ = x.shape
+    k = _n_chunks(n, chunk)
+    xs = x.reshape(k, n // k, -1)
+    ts = targets.reshape(k, n // k)
+    ws = weights.reshape(k, n // k)
+
+    def body(total, inp):
+        x_c, t_c, w_c = inp
+        l = _head_logits(x_c, w, bias, vocab_major)
+        nll, lse = _ce_fwd_math(l, t_c)
+        return total + jnp.sum(nll * w_c), lse
+
+    total, lse = jax.lax.scan(body, jnp.float32(0.0), (xs, ts, ws))
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return total / denom, (x, w, bias, targets, weights,
+                           lse.reshape(n), denom)
+
+
+def _flce_bwd(vocab_major, chunk, res, g):
+    x, w, bias, targets, weights, lse, denom = res
+    n, _ = x.shape
+    v = w.shape[0] if vocab_major else w.shape[-1]
+    k = _n_chunks(n, chunk)
+    xs = x.reshape(k, n // k, -1)
+    ts = targets.reshape(k, n // k)
+    ws = weights.reshape(k, n // k)
+    ls = lse.reshape(k, n // k)
+    gscale = jnp.asarray(g, jnp.float32) / denom
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    db0 = None if bias is None else jnp.zeros(bias.shape, jnp.float32)
+
+    def body(carry, inp):
+        dw_acc, db_acc = carry
+        x_c, t_c, w_c, lse_c = inp
+        l = _head_logits(x_c, w, bias, vocab_major)
+        p = jnp.exp(l.astype(jnp.float32) - lse_c[..., None])
+        onehot = jax.nn.one_hot(t_c, v, dtype=jnp.float32)
+        dl = ((p - onehot) * (w_c * gscale)[..., None]).astype(x_c.dtype)
+        if vocab_major:
+            # dl [n, V], w [V, E] -> dx [n, E];  dw [V, E] = dl^T @ x_c
+            dx_c = jax.lax.dot_general(dl, w, (((1,), (0,)), ((), ())))
+            dw_c = jax.lax.dot_general(
+                dl, x_c, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            # dl [n, V], w [E, V] -> dx [n, E];  dw [E, V] = x_c^T @ dl
+            dx_c = jax.lax.dot_general(dl, w, (((1,), (1,)), ((), ())))
+            dw_c = jax.lax.dot_general(
+                x_c, dl, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        db_c = None if db_acc is None else db_acc + jnp.sum(
+            dl.astype(jnp.float32), axis=0)
+        return (dw_acc + dw_c, db_c), dx_c
+
+    (dw, db), dxs = jax.lax.scan(body, (dw0, db0), (xs, ts, ws, ls))
+    dx = dxs.reshape(x.shape)
+    return (dx, dw.astype(w.dtype),
+            None if bias is None else db.astype(bias.dtype), None, None)
+
+
+fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)

@@ -348,6 +348,9 @@ class DeepSpeedEngine:
         self._offload_opt = None
         self._zero_acc_fn = None
         self._host_grad_acc = None  # offload_param gas>1 host accumulator
+        # device grad leaves whose host copies are in flight; consumed only
+        # after the NEXT micro step is dispatched so transfer overlaps compute
+        self._pending_grad_leaves = None
 
         # host counters
         self.micro_steps = 0
@@ -950,9 +953,16 @@ class DeepSpeedEngine:
             )
             return new_acc, loss
 
+        # replace_acc with gas > 1: the previous micro step's grad leaves
+        # stay alive until their in-flight host copies are drained
+        # (double-buffered host accumulation), so the acc_grads argument
+        # must NOT be donated out from under them. At gas == 1 the offload
+        # step consumes the grads before the next dispatch — keep donating
+        # so peak grad allocation stays at one tree.
+        no_donate = replace_acc and gas > 1
         return jax.jit(
             fwd_bwd,
-            donate_argnums=(1,),
+            donate_argnums=() if no_donate else (1,),
             out_shardings=(self._grad_shardings, None),
         )
 
@@ -1161,6 +1171,7 @@ class DeepSpeedEngine:
 
         # grads accumulate eagerly (the donated buffer is consumed here);
         # backward() is the protocol-parity bookkeeping step
+        prev_pending = self._pending_grad_leaves
         self._acc_grads, loss = self._fwd_bwd_fn(
             self._params, self._acc_grads, device_batch, self._rng,
             self.micro_steps, scale
@@ -1170,29 +1181,42 @@ class DeepSpeedEngine:
             # streamed-param mode replaces the grad tree each micro step;
             # accumulate host-side f32 (the host optimizer consumes numpy
             # grads anyway, and each micro grad is already scaled by 1/gas).
-            # Kick off ALL device->host copies before consuming any so the
-            # transfers pipeline instead of serializing leaf by leaf.
-            dev_leaves = jax.tree.leaves(self._acc_grads)
-            for leaf in dev_leaves:
+            # Double-buffered: this step's leaves only have their async
+            # copies STARTED here; they are materialized after the NEXT
+            # micro step is dispatched (or at the boundary drain), so the
+            # device->host transfer of step i overlaps the compute of
+            # step i+1 instead of serializing the accumulation window.
+            self._pending_grad_leaves = jax.tree.leaves(self._acc_grads)
+            for leaf in self._pending_grad_leaves:
                 if hasattr(leaf, "copy_to_host_async"):
                     leaf.copy_to_host_async()
-            leaves = [np.asarray(leaf) for leaf in dev_leaves]
-            if self._host_grad_acc is None:
-                self._host_grad_acc = [
-                    np.asarray(l, np.float32).copy() for l in leaves]
-            else:
-                for buf, l in zip(self._host_grad_acc, leaves):
-                    buf += np.asarray(l, np.float32)
+            if prev_pending is not None:
+                self._accumulate_host_grads(prev_pending)
         self._backward_pending = True
         self._last_loss = loss
         if self.wall_clock_breakdown:
             self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
 
+    def _accumulate_host_grads(self, dev_leaves):
+        """Fold one micro step's (already copy-initiated) grad leaves into
+        the host-side f32 accumulator."""
+        leaves = [np.asarray(leaf) for leaf in dev_leaves]
+        if self._host_grad_acc is None:
+            self._host_grad_acc = [
+                np.asarray(l, np.float32).copy() for l in leaves]
+        else:
+            for buf, l in zip(self._host_grad_acc, leaves):
+                buf += np.asarray(l, np.float32)
+
     def _take_offload_step(self):
         """Host optimizer step (ZeRO-Offload): grads to host, native fused
         Adam over fp32 masters, compute-dtype params back to device."""
         scale = float(self._ls_state.scale) if self.fp16_enabled else 1.0
+        if self._pending_grad_leaves is not None:
+            # drain the last micro step's in-flight copies
+            self._accumulate_host_grads(self._pending_grad_leaves)
+            self._pending_grad_leaves = None
         grads_src = self._acc_grads
         if self._host_grad_acc is not None:
             grads_src = jax.tree.unflatten(
@@ -1620,6 +1644,7 @@ class DeepSpeedEngine:
         # a partial accumulation window from before the restore must not
         # leak into the first post-restore step
         self._host_grad_acc = None
+        self._pending_grad_leaves = None
         model_sd = self._merge_expert_files(
             model_state["module"], model_state.get("moe_experts"),
             load_dir, tag, "model")
