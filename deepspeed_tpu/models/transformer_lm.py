@@ -91,8 +91,8 @@ class GPTConfig:
     # seq 1024 (measured, 1.3B A/B on one v5e chip), so "auto" enables it
     # only where the saved memory is material: when the logits slab
     # (tokens x vocab x itemsize for the global batch) reaches 1 GB —
-    # long sequences or 100k+ vocabularies. An int sets the token chunk
-    # size explicitly (default 2048).
+    # long sequences or 100k+ vocabularies. An int >= 1 enables it with
+    # that token chunk size (default 2048); 0/False disable.
     fused_head_ce: Any = "auto"
     # MoE (reference deepspeed/moe/): 0 experts = dense MLP everywhere
     moe_num_experts: int = 0
@@ -719,7 +719,9 @@ class GPT(nn.Module):
 
             targets, wts = _shifted_targets(labels, attention_mask)
             flat = x.astype(cfg.dtype).reshape(-1, cfg.n_embd)
-            chunk = fused if isinstance(fused, int) and fused > 1 else 2048
+            # bool first: True is an int and would read as chunk=1
+            chunk = (fused if isinstance(fused, int)
+                     and not isinstance(fused, bool) else 2048)
             loss = fused_linear_cross_entropy(
                 cfg.tie_word_embeddings, chunk, flat, head_w, head_b,
                 targets.reshape(-1), wts.reshape(-1))

@@ -80,8 +80,8 @@ softmax_cross_entropy.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
 # Fused LM-head + cross entropy (never materializes [N, V] logits)
 # ---------------------------------------------------------------------------
 def _n_chunks(n: int, chunk: int) -> int:
-    """Smallest chunk count that divides n with chunks <= ``chunk`` tokens
-    (static shapes: ``chunk`` caps the materialized [chunk, V] slab)."""
+    """Chunk count for n tokens (callers pad n to a multiple of chunk;
+    the divisor walk is a safety net for direct _flce users)."""
     k = -(-n // max(1, chunk))
     while n % k:
         k += 1
@@ -97,7 +97,6 @@ def _head_logits(x_c, w, bias, vocab_major):
     return l
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
 def fused_linear_cross_entropy(vocab_major, chunk, x, w, bias, targets,
                                weights):
     """Weighted-mean nll of ``softmax(x @ w + bias)`` WITHOUT ever
@@ -114,7 +113,23 @@ def fused_linear_cross_entropy(vocab_major, chunk, x, w, bias, targets,
 
     x: [N, E] compute dtype; w: [E, V] ([V, E] when ``vocab_major`` — the
     tied-embedding layout); targets: [N] int; weights: [N] f32 mask.
+
+    N is padded up to a multiple of the chunk (dummy target, zero weight)
+    so an awkward token count never degenerates into near-token-count
+    scan iterations hunting for a divisor.
     """
+    n = x.shape[0]
+    c = min(max(1, chunk), n)
+    pad = (-n) % c
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        targets = jnp.pad(targets, (0, pad))
+        weights = jnp.pad(weights, (0, pad))
+    return _flce(vocab_major, c, x, w, bias, targets, weights)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _flce(vocab_major, chunk, x, w, bias, targets, weights):
     loss, _ = _flce_fwd(vocab_major, chunk, x, w, bias, targets, weights)
     return loss
 
@@ -181,4 +196,4 @@ def _flce_bwd(vocab_major, chunk, res, g):
             None if bias is None else db.astype(bias.dtype), None, None)
 
 
-fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)
+_flce.defvjp(_flce_fwd, _flce_bwd)

@@ -5,11 +5,18 @@ rendezvous (tests/unit/common.py:68 DistributedTest). Everything else in this
 suite simulates multi-device SPMD inside one process; this file is the true
 multi-host analogue: two OS processes, each with 4 virtual CPU devices,
 rendezvous through ``jax.distributed`` (the path `comm.init_distributed`
-wraps — reference comm/comm.py:577) and jointly execute one 8-device data-
-parallel training program whose gradient psum spans the process boundary.
+wraps — reference comm/comm.py:577) and jointly execute one 8-device
+training program whose collectives span the process boundary:
 
-The child losses are compared against a single-process 8-device run of the
-identical config/data, so the cross-host execution is held to numerical
+* ``stage2``  — ZeRO-2 data parallel: the gradient psum crosses hosts.
+* ``stage3``  — ZeRO-3 (fsdp=8): parameter shards live on both hosts and
+  the gather-on-use all-gathers cross the boundary every step.
+* ``tp8``     — tensor-parallel GPT over tp=8: every column/row-parallel
+  matmul's activation psum crosses hosts (the ICI/DCN path a Megatron-style
+  mpu exercises in the reference).
+
+Each child's loss stream is compared against a single-process 8-device run
+of the identical scenario, so cross-host execution is held to numerical
 parity with the single-host mesh, not just "it didn't crash".
 """
 
@@ -24,67 +31,92 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-HIDDEN = 16
 STEPS = 5
-MICRO_PER_DEV = 2
-GLOBAL_BATCH = MICRO_PER_DEV * 8
 
+# Runs in BOTH the parent (single-process reference) and the spawned
+# children; defines run_case(name) -> list of per-step losses.
 TRAIN_SNIPPET = """
-import json
 import numpy as np
 import jax.numpy as jnp
 import flax.linen as nn
 import deepspeed_tpu
 
+STEPS = %(steps)d
+
 
 class M(nn.Module):
     @nn.compact
     def __call__(self, x, y=None, deterministic=True):
-        x = nn.relu(nn.Dense({hidden}, name="l0")(x))
+        x = nn.relu(nn.Dense(16, name="l0")(x))
         x = nn.Dense(1, name="head")(x)
         if y is None:
             return x
         return jnp.mean((x - y) ** 2)
 
 
-def batches():
+def _mlp_batches():
     rng = np.random.RandomState(0)
-    w = rng.randn({hidden}, 1).astype(np.float32)
-    x = rng.randn({global_batch}, {hidden}).astype(np.float32)
-    batch = {{"x": x, "y": (x @ w).astype(np.float32)}}
+    w = rng.randn(16, 1).astype(np.float32)
+    x = rng.randn(16, 16).astype(np.float32)
+    batch = {"x": x, "y": (x @ w).astype(np.float32)}
     while True:
         yield batch
 
 
-config = {{
-    "train_micro_batch_size_per_gpu": {micro},
-    "gradient_accumulation_steps": 1,
-    "optimizer": {{"type": "AdamW", "params": {{"lr": 1e-2}}}},
-    "zero_optimization": {{"stage": 2}},
-    "steps_per_print": 10 ** 9,
-}}
-engine, _, _, _ = deepspeed_tpu.initialize(model=M(), config=config)
-it = batches()
-losses = [float(engine.train_batch(it)) for _ in range({steps})]
-""".format(hidden=HIDDEN, global_batch=GLOBAL_BATCH, micro=MICRO_PER_DEV,
-           steps=STEPS)
+def _token_batches(batch_size):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, size=(batch_size, 32)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    while True:
+        yield batch
+
+
+def run_case(name):
+    base = {
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 10 ** 9,
+    }
+    if name == "stage2":
+        cfg = dict(base, train_micro_batch_size_per_gpu=2,
+                   zero_optimization={"stage": 2})
+        model, it = M(), _mlp_batches()
+    elif name == "stage3":
+        cfg = dict(base, train_micro_batch_size_per_gpu=2,
+                   zero_optimization={"stage": 3,
+                                      "stage3_param_persistence_threshold": 0})
+        model, it = M(), _mlp_batches()
+    elif name == "tp8":
+        from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+        cfg = dict(base, train_micro_batch_size_per_gpu=4,
+                   tpu={"mesh": {"dp": 1, "tp": 8}})
+        model = GPT(GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                              n_layer=2, n_head=8, dtype=jnp.float32,
+                              param_dtype=jnp.float32))
+        it = _token_batches(4)
+    else:
+        raise ValueError(name)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return [float(engine.train_batch(it)) for _ in range(STEPS)]
+""" % {"steps": STEPS}
 
 CHILD = """
 import os, sys, json
 import jax
 jax.config.update("jax_platforms", "cpu")
-sys.path.insert(0, {repo!r})
+sys.path.insert(0, %(repo)r)
 # rendezvous must precede ANY backend initialisation (jax.devices etc.)
 from deepspeed_tpu.comm import comm
 comm.init_distributed()
-{train}
+%(train)s
+losses = run_case(%(case)r)
 assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 8, jax.device_count()
 assert len(jax.local_devices()) == 4, jax.local_devices()
 assert comm.get_rank() == int(os.environ["DS_TPU_PROC_ID"])
 assert comm.get_world_size() == 8  # world size counts devices, not processes
 print("LOSSES:" + json.dumps(losses))
-""".format(repo=REPO, train=TRAIN_SNIPPET)
+"""
 
 
 def _free_port():
@@ -95,22 +127,20 @@ def _free_port():
     return port
 
 
-def _single_process_reference():
-    """Same model/config/data on this process's own 8-device mesh."""
+def _single_process_reference(case):
+    """Same scenario on this process's own 8-device mesh."""
     ns = {}
     exec(TRAIN_SNIPPET, ns)
-    return ns["losses"]
+    return ns["run_case"](case)
 
 
-def test_two_process_training_matches_single_host(eight_devices, tmp_path):
-    losses_ref = _single_process_reference()
-    assert losses_ref[-1] < losses_ref[0], losses_ref
-
+def _spawn_pair(case, tmp_path):
     port = _free_port()
     base_flags = " ".join(
         f for f in os.environ.get("XLA_FLAGS", "").split()
         if "xla_force_host_platform_device_count" not in f
     )
+    child = CHILD % {"repo": REPO, "train": TRAIN_SNIPPET, "case": case}
     procs = []
     for pid in range(2):
         env = dict(os.environ)
@@ -124,7 +154,7 @@ def test_two_process_training_matches_single_host(eight_devices, tmp_path):
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", CHILD],
+                [sys.executable, "-c", child],
                 env=env, cwd=str(tmp_path), text=True,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             )
@@ -155,6 +185,16 @@ def test_two_process_training_matches_single_host(eight_devices, tmp_path):
         line = [ln for ln in out.splitlines() if ln.startswith("LOSSES:")]
         assert line, out
         per_proc.append(json.loads(line[-1][len("LOSSES:"):]))
+    return per_proc
+
+
+@pytest.mark.parametrize("case", ["stage2", "stage3", "tp8"])
+def test_two_process_training_matches_single_host(case, eight_devices,
+                                                  tmp_path):
+    losses_ref = _single_process_reference(case)
+    assert losses_ref[-1] < losses_ref[0], losses_ref
+
+    per_proc = _spawn_pair(case, tmp_path)
 
     # both processes observe the identical (replicated) loss stream …
     np.testing.assert_allclose(per_proc[0], per_proc[1], rtol=1e-6)

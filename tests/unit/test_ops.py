@@ -212,6 +212,21 @@ class TestFusedLinearCrossEntropy:
             rel = np.linalg.norm(af - rf) / max(np.linalg.norm(rf), 1e-9)
             assert rel < 0.03, rel
 
+    def test_odd_token_count_pads_not_degenerates(self, ):
+        """n with no divisor near the chunk cap is padded (zero-weight
+        dummy tokens), not split into near-token-count chunks."""
+        from deepspeed_tpu.ops.cross_entropy import (
+            fused_linear_cross_entropy)
+        x, w, b, t, wt = self._setup(False, jnp.float32, n=53)
+        ref_l, ref_gx = jax.value_and_grad(
+            lambda xx: self._unfused(False, xx, w, b, t, wt))(x)
+        got_l, got_gx = jax.value_and_grad(
+            lambda xx: fused_linear_cross_entropy(
+                False, 16, xx, w, b, t, wt))(x)
+        np.testing.assert_allclose(float(got_l), float(ref_l), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_gx), np.asarray(ref_gx),
+                                   rtol=1e-4, atol=1e-6)
+
     def test_chunk_count_divides_tokens(self):
         from deepspeed_tpu.ops.cross_entropy import _n_chunks
         assert _n_chunks(6144, 2048) == 3
