@@ -81,16 +81,58 @@ class MeshTopology:
         self.mesh = Mesh(device_array, AXIS_ORDER)
 
     @staticmethod
+    def _derive_dcn_shape(shape: Tuple[int, ...], n_slices: int
+                          ) -> Tuple[int, ...]:
+        """Factor the slice count into the OUTERMOST axes (AXIS_ORDER:
+        pp, dp, fsdp, ...), so collectives of the inner axes (tp/sp/ep)
+        never cross the data-center network: each element of the result
+        divides the global axis size; their product is n_slices."""
+        import math
+
+        # only pp/dp/fsdp may absorb the slice dimension; a DCN hop inside
+        # an ep all-to-all, sp ring, or tp matmul psum defeats the layout
+        n_dcn_eligible = 3  # AXIS_ORDER prefix (pp, dp, fsdp)
+        remaining = n_slices
+        dcn = []
+        for i, size in enumerate(shape):
+            g = math.gcd(size, remaining) if i < n_dcn_eligible else 1
+            dcn.append(g)
+            remaining //= g
+        if remaining != 1:
+            raise ValueError(
+                f"cannot distribute {n_slices} slices over mesh axes "
+                f"{dict(zip(AXIS_ORDER, shape))}: the outer axes "
+                f"(pp/dp/fsdp) must jointly absorb a factor of {n_slices} "
+                f"so no tp/sp/ep collective crosses DCN"
+            )
+        return tuple(dcn)
+
+    @staticmethod
     def _arrange(devices: List, shape: Tuple[int, ...]) -> np.ndarray:
-        """Physical device layout. On real TPU slices use mesh_utils so the
-        innermost axes land on adjacent ICI neighbours; plain reshape otherwise."""
+        """Physical device layout. On one real TPU slice use mesh_utils so
+        the innermost axes land on adjacent ICI neighbours; on a MULTI-SLICE
+        job (device.slice_index varies) build a hybrid ICI x DCN mesh where
+        the slice dimension is absorbed by the outermost parallel axes —
+        the 'collectives ride ICI, not DCN' layout. Plain reshape off-TPU."""
         try:
             from jax.experimental import mesh_utils
-
-            if devices and getattr(devices[0], "platform", "cpu") == "tpu":
-                return mesh_utils.create_device_mesh(shape, devices=devices)
         except Exception:
-            pass
+            return np.array(devices).reshape(shape)
+        if devices and getattr(devices[0], "platform", "cpu") == "tpu":
+            slice_ids = {getattr(d, "slice_index", None) or 0
+                         for d in devices}
+            if len(slice_ids) > 1:
+                # multi-slice must not silently fall back: a plain reshape
+                # would route tp/sp collectives over DCN
+                dcn_shape = MeshTopology._derive_dcn_shape(
+                    shape, len(slice_ids))
+                per_slice = tuple(s // d for s, d in zip(shape, dcn_shape))
+                return mesh_utils.create_hybrid_device_mesh(
+                    per_slice, dcn_shape, devices=devices)
+            try:
+                return mesh_utils.create_device_mesh(shape, devices=devices)
+            except Exception:
+                pass
         return np.array(devices).reshape(shape)
 
     # -- size queries (parity: groups.get_data_parallel_world_size etc.) ---

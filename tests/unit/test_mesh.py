@@ -76,3 +76,73 @@ def test_shard_largest_dim_spec():
     assert shard_largest_dim_spec((8,), "fsdp", 8, min_size=100) == PartitionSpec()
     # axis size 1 -> replicated
     assert shard_largest_dim_spec((128, 64), "fsdp", 1) == PartitionSpec()
+
+
+# ---------------------------------------------------------------------------
+# Multi-slice (DCN) layout: the slice count must land on the OUTERMOST axes
+# so tp/sp/ep collectives ride ICI only (jax hybrid mesh; the reference's
+# analogue is NCCL ring construction preferring NVLink over IB)
+# ---------------------------------------------------------------------------
+class _FakeTpuDev:
+    platform = "tpu"
+
+    def __init__(self, i, slice_index):
+        self.id = i
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"tpu{self.id}@{self.slice_index}"
+
+
+def test_derive_dcn_shape_prefers_outer_axes():
+    from deepspeed_tpu.parallel.mesh import MeshTopology
+
+    # AXIS_ORDER = (pp, dp, fsdp, ep, sp, tp)
+    # 2 slices, dp=2 available -> dp absorbs the slice dim
+    assert MeshTopology._derive_dcn_shape((1, 2, 2, 1, 1, 2), 2) == \
+        (1, 2, 1, 1, 1, 1)
+    # pp=2 outranks dp
+    assert MeshTopology._derive_dcn_shape((2, 2, 1, 1, 1, 2), 2) == \
+        (2, 1, 1, 1, 1, 1)
+    # 4 slices split across pp=2 x dp=2
+    assert MeshTopology._derive_dcn_shape((2, 2, 2, 1, 1, 1), 4) == \
+        (2, 2, 1, 1, 1, 1)
+
+
+def test_derive_dcn_shape_rejects_tp_only_split():
+    from deepspeed_tpu.parallel.mesh import MeshTopology
+
+    # 2 slices but every outer axis is odd-sized except tp: a tp split
+    # would put every matmul psum on DCN -> hard error, not silent layout
+    with pytest.raises(ValueError, match="DCN"):
+        # shape product must still be divisible overall for the message
+        # path: (pp,dp,fsdp,ep,sp,tp) = (1,3,1,1,1,2), 2 slices
+        MeshTopology._derive_dcn_shape((1, 3, 1, 1, 1, 2), 2)
+
+
+def test_arrange_routes_multislice_to_hybrid_mesh(monkeypatch):
+    from jax.experimental import mesh_utils
+    from deepspeed_tpu.parallel.mesh import MeshTopology
+
+    devs = [_FakeTpuDev(i, slice_index=i // 4) for i in range(8)]
+    calls = {}
+
+    def fake_hybrid(per_slice, dcn_shape, devices=None):
+        calls["per_slice"] = per_slice
+        calls["dcn"] = dcn_shape
+        return np.array(devices, dtype=object).reshape(
+            tuple(p * d for p, d in zip(per_slice, dcn_shape)))
+
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", fake_hybrid)
+    # global mesh (pp,dp,fsdp,ep,sp,tp) = (1,2,2,1,1,2) over 2 slices
+    arr = MeshTopology._arrange(devs, (1, 2, 2, 1, 1, 2))
+    assert calls["dcn"] == (1, 2, 1, 1, 1, 1)
+    assert calls["per_slice"] == (1, 1, 2, 1, 1, 2)
+    assert arr.shape == (1, 2, 2, 1, 1, 2)
+
+
+def test_arrange_single_slice_unchanged(eight_devices):
+    from deepspeed_tpu.parallel.mesh import MeshTopology
+
+    arr = MeshTopology._arrange(list(eight_devices), (1, 8, 1, 1, 1, 1))
+    assert arr.shape == (1, 8, 1, 1, 1, 1)
