@@ -14,6 +14,9 @@ training program whose collectives span the process boundary:
 * ``tp8``     — tensor-parallel GPT over tp=8: every column/row-parallel
   matmul's activation psum crosses hosts (the ICI/DCN path a Megatron-style
   mpu exercises in the reference).
+* ``sp_ring`` — ring-attention sequence parallelism over sp=8: the KV ring
+  ppermute hops between hosts every attention step — the long-context
+  distributed path (absent in the reference snapshot; SURVEY §2.2).
 
 Each child's loss stream is compared against a single-process 8-device run
 of the identical scenario, so cross-host execution is held to numerical
@@ -94,6 +97,15 @@ def run_case(name):
                               n_layer=2, n_head=8, dtype=jnp.float32,
                               param_dtype=jnp.float32))
         it = _token_batches(4)
+    elif name == "sp_ring":
+        from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+        cfg = dict(base, train_micro_batch_size_per_gpu=2,
+                   tpu={"mesh": {"dp": 1, "sp": 8}})
+        model = GPT(GPTConfig(vocab_size=128, n_positions=32, n_embd=32,
+                              n_layer=2, n_head=4, dtype=jnp.float32,
+                              param_dtype=jnp.float32,
+                              sequence_parallel="ring"))
+        it = _token_batches(2)
     else:
         raise ValueError(name)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
@@ -188,7 +200,7 @@ def _spawn_pair(case, tmp_path):
     return per_proc
 
 
-@pytest.mark.parametrize("case", ["stage2", "stage3", "tp8"])
+@pytest.mark.parametrize("case", ["stage2", "stage3", "tp8", "sp_ring"])
 def test_two_process_training_matches_single_host(case, eight_devices,
                                                   tmp_path):
     losses_ref = _single_process_reference(case)
