@@ -9,7 +9,12 @@ import subprocess
 import tempfile
 from typing import Optional
 
-from deepspeed_tpu.utils.logging import logger
+try:
+    from deepspeed_tpu.utils.logging import logger
+except Exception:  # standalone use (setup.py AOT build: no jax installed)
+    import logging
+
+    logger = logging.getLogger("deepspeed_tpu.native")
 
 _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
 _SOURCES = ("cpu_adam.cpp", "aio.cpp")
@@ -32,17 +37,39 @@ def _content_hash() -> str:
     return h.hexdigest()[:16]
 
 
-def build(verbose: bool = False) -> str:
-    """Compile the shared library (content-hashed, idempotent)."""
-    out = os.path.join(_cache_dir(), f"libds_tpu_native_{_content_hash()}.so")
+def _prebuilt_path() -> Optional[str]:
+    """AOT library shipped by ``setup.py`` with DS_BUILD_OPS=1 (reference
+    setup.py:115-163 DS_BUILD_* ahead-of-time builds). Only honoured when
+    the content hash matches the installed sources."""
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "prebuilt",
+                     f"libds_tpu_native_{_content_hash()}.so")
+    return p if os.path.exists(p) else None
+
+
+def build(verbose: bool = False, portable: bool = False,
+          out_path: Optional[str] = None) -> str:
+    """Compile the shared library (content-hashed, idempotent).
+
+    ``portable`` drops ``-march=native`` — required for an AOT artifact
+    that ships in a wheel (a native-ISA build can SIGILL on an older
+    target CPU); the private JIT cache keeps the native tuning."""
+    if out_path is None:
+        pre = _prebuilt_path()
+        if pre is not None:
+            return pre
+        out = os.path.join(_cache_dir(),
+                           f"libds_tpu_native_{_content_hash()}.so")
+    else:
+        out = out_path
     if os.path.exists(out):
         return out
     srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
     # per-process tmp name: concurrent first-use builds (one per launcher
     # worker) must not clobber each other's half-written output
     tmp = f"{out}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-pthread", "-o", tmp] + srcs
+    arch = [] if portable else ["-march=native"]
+    cmd = (["g++", "-O3"] + arch + ["-std=c++17", "-shared", "-fPIC",
+           "-pthread", "-o", tmp] + srcs)
     if verbose:
         logger.info("building native ops: " + " ".join(cmd))
     try:
@@ -59,8 +86,8 @@ def load_library(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
     global _LIB
     if _LIB is not None:
         return _LIB
-    path = os.path.join(_cache_dir(),
-                        f"libds_tpu_native_{_content_hash()}.so")
+    path = _prebuilt_path() or os.path.join(
+        _cache_dir(), f"libds_tpu_native_{_content_hash()}.so")
     if not os.path.exists(path):
         if not build_if_missing:
             return None

@@ -1,0 +1,55 @@
+"""Install hooks for deepspeed_tpu (metadata lives in pyproject.toml).
+
+Ahead-of-time native-op build, the analogue of the reference's
+``DS_BUILD_*`` flags (reference setup.py:115-163): by default the C++ host
+ops (CPU Adam/Adagrad, aio threadpool) JIT-compile on first use via
+``ops/native/builder.py``; with
+
+    DS_BUILD_OPS=1 pip install .
+
+they are compiled at install time into ``deepspeed_tpu/ops/native/prebuilt/``
+and the builder loads them without ever invoking a compiler on the target
+machine. The AOT library is built WITHOUT ``-march=native`` (it must run on
+any x86-64 target, not just the build host) and is content-hashed against
+the shipped sources, so a stale prebuilt is ignored, never mis-loaded.
+
+The builder module is loaded standalone from its file path — importing the
+``deepspeed_tpu`` package would pull in jax, which is absent from pip's
+isolated PEP 517 build environment.
+"""
+
+import importlib.util
+import os
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+def _load_builder(path):
+    spec = importlib.util.spec_from_file_location("_ds_native_builder", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class build_py_with_ops(build_py):
+    def run(self):
+        super().run()
+        if os.environ.get("DS_BUILD_OPS") != "1":
+            return
+        pkg = os.path.join(self.build_lib, "deepspeed_tpu", "ops", "native")
+        try:
+            builder = _load_builder(os.path.join(pkg, "builder.py"))
+            dest = os.path.join(pkg, "prebuilt")
+            os.makedirs(dest, exist_ok=True)
+            name = f"libds_tpu_native_{builder._content_hash()}.so"
+            builder.build(verbose=True, portable=True,
+                          out_path=os.path.join(dest, name))
+        except RuntimeError as e:
+            raise SystemExit(
+                f"DS_BUILD_OPS=1 but the native op build failed: {e}\n"
+                "Unset DS_BUILD_OPS to fall back to JIT-on-first-use."
+            )
+
+
+setup(cmdclass={"build_py": build_py_with_ops})

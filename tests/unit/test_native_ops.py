@@ -171,3 +171,35 @@ class TestSwapper:
         sw = OptimizerStateSwapper(str(tmp_path / "s2"))
         with pytest.raises(RuntimeError):
             sw.swap_in_tree()
+
+
+class TestPrebuiltLookup:
+    """setup.py DS_BUILD_OPS=1 ships an AOT library in ops/native/prebuilt/;
+    the builder must prefer it (content-hash-matched) over a JIT compile."""
+
+    def test_prebuilt_preferred_and_stale_ignored(self, tmp_path):
+        from deepspeed_tpu.ops.native import builder
+
+        pre_dir = os.path.join(os.path.dirname(builder.__file__), "prebuilt")
+        if os.path.exists(pre_dir):
+            pytest.skip("installed with DS_BUILD_OPS=1 (real prebuilt/)")
+        jit_lib = builder.build()  # warm the JIT cache first
+        try:
+            os.makedirs(pre_dir, exist_ok=True)
+        except OSError:
+            pytest.skip("package tree is read-only")
+        try:
+            pre_lib = os.path.join(pre_dir, os.path.basename(jit_lib))
+            with open(jit_lib, "rb") as f:
+                payload = f.read()
+            with open(pre_lib, "wb") as f:
+                f.write(payload)
+            assert builder.build() == pre_lib
+            # a stale hash (sources changed since the AOT build) is ignored
+            os.rename(pre_lib, os.path.join(pre_dir,
+                                            "libds_tpu_native_0000.so"))
+            assert builder.build() == jit_lib
+        finally:
+            import shutil
+
+            shutil.rmtree(pre_dir, ignore_errors=True)
