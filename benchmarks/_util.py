@@ -32,12 +32,10 @@ def time_train_steps(engine, batch, steps: int = 5,
     return (time.time() - t0) / steps
 
 
-def fence(tree):
-    """Drain the device queue before reading the wall clock.
+def fence(tree=None):
+    """Drain the device queue before reading the wall clock
+    (deepspeed_tpu.utils.timer.fence: scalar host read of a device-side
+    reduction; block_until_ready is not a reliable fence on the tunnel)."""
+    from deepspeed_tpu.utils.timer import fence as _fence
 
-    ``block_until_ready`` can return before the accelerator compute queue
-    drains on the tunneled transport, so fence with a scalar host read of a
-    device-side reduction instead (a full-array transfer would poison the
-    measurement).
-    """
-    return float(jnp.sum(jax.tree.leaves(tree)[0].astype(jnp.float32)))
+    return _fence(tree)

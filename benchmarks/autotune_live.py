@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Live autotuner run on one real chip (reference autotuning/ runs its
+experiments as separate launcher jobs; here each experiment is an
+in-process engine build + measured steps — `Autotuner.measure`).
+
+Tunes GPT-2 125M over zero-stage x micro-batch x remat policy and writes
+the ranked results + the winning config to
+``benchmarks/autotune_live_results.json``.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config
+
+    seq = 1024
+    base = {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "FusedAdam", "params": {"lr": 6e-4}},
+        "steps_per_print": 10 ** 9,
+        "autotuning": {
+            "enabled": True,
+            "min_train_micro_batch_size_per_gpu": 4,
+            "max_train_micro_batch_size_per_gpu": 32,
+            "num_tuning_micro_batch_sizes": 3,
+            "zero_stages": [0, 1],
+            "remat_policies": ["none", "selective"],
+            "start_profile_step": 2,
+            "end_profile_step": 6,
+        },
+    }
+
+    def model_factory():
+        cfg = gpt2_config("gpt2-125m", n_positions=seq, dtype=jnp.bfloat16,
+                          scan_layers=True, use_flash_attention="auto")
+        return GPT(cfg)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50257, size=(256, seq)).astype(np.int32)
+    data = [{"input_ids": ids[i], "labels": ids[i]} for i in range(256)]
+
+    tuner = Autotuner(base)
+    exps = tuner.generate_experiments()
+    results = []
+    for exp in exps:
+        metric = tuner.measure(model_factory, data, exp)
+        results.append({"exp": exp, "samples_per_sec": metric})
+        print(json.dumps(results[-1]))
+    ok = [r for r in results if r["samples_per_sec"]]
+    ok.sort(key=lambda r: -r["samples_per_sec"])
+    out = {
+        "model": "gpt2-125m", "seq": seq,
+        "experiments": results,
+        "best": ok[0] if ok else None,
+        "best_config": tuner.exp_to_config(ok[0]["exp"]) if ok else None,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "autotune_live_results.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("BEST", json.dumps(ok[0]) if ok else None)
+
+
+if __name__ == "__main__":
+    main()

@@ -171,13 +171,13 @@ class Autotuner:
                 engine.train_batch(it)  # warmup + compile
             steps = max(self.cfg.end_profile_step
                         - self.cfg.start_profile_step, 1)
-            # fence async dispatch so compile/warmup tails don't leak into
-            # the timed region (same pattern as flops_profiler latency)
-            jax.block_until_ready(engine._params)
+            from deepspeed_tpu.utils.timer import fence
+
+            fence(engine.params)
             t0 = time.perf_counter()
             for _ in range(steps):
                 engine.train_batch(it)
-            jax.block_until_ready(engine._params)
+            fence(engine.params)
             dt = (time.perf_counter() - t0) / steps
         except Exception as e:
             logger.warning(f"experiment {exp} failed: {e}")

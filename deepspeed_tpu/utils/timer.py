@@ -32,6 +32,28 @@ def _sync():
         pass
 
 
+def fence(tree=None):
+    """Drain the device compute queue before reading the wall clock.
+
+    ``block_until_ready`` can return BEFORE the accelerator queue drains on
+    tunneled transports, so fence with a scalar HOST READ of a device-side
+    reduction — of the first leaf of ``tree`` (e.g. ``engine.params``) if
+    given, else of a fresh tiny program enqueued behind everything pending.
+    Never read a full array as a fence: the transfer poisons the timing.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(tree) if tree is not None else []
+    if leaves:
+        float(jnp.sum(leaves[0].astype(jnp.float32)))
+        return
+    global _fence_fn
+    if _fence_fn is None:
+        _fence_fn = jax.jit(lambda: jnp.zeros(()))
+    float(_fence_fn())
+
+
 class _Timer:
     def __init__(self, name: str):
         self.name_ = name
