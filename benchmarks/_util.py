@@ -2,8 +2,7 @@
 
 import time
 
-import jax
-import jax.numpy as jnp
+from deepspeed_tpu.utils.timer import fence  # noqa: F401  (re-export)
 
 
 def gpt_flops_per_token(cfg, seq: int) -> float:
@@ -32,10 +31,3 @@ def time_train_steps(engine, batch, steps: int = 5,
     return (time.time() - t0) / steps
 
 
-def fence(tree=None):
-    """Drain the device queue before reading the wall clock
-    (deepspeed_tpu.utils.timer.fence: scalar host read of a device-side
-    reduction; block_until_ready is not a reliable fence on the tunnel)."""
-    from deepspeed_tpu.utils.timer import fence as _fence
-
-    return _fence(tree)

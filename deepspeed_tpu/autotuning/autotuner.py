@@ -162,8 +162,6 @@ class Autotuner:
 
         config = self.exp_to_config(exp)
         try:
-            import jax
-
             engine, _, loader, _ = deepspeed_tpu.initialize(
                 model=model_factory(), config=config, training_data=data)
             it = iter(RepeatingLoader(loader))

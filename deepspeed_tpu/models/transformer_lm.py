@@ -87,12 +87,16 @@ class GPTConfig:
     sequence_parallel: str = "none"
     # fused LM-head + cross entropy (ops/cross_entropy.py
     # fused_linear_cross_entropy): never materializes the [tokens, vocab]
-    # logits. True | False | "auto". The chunked head scan costs ~0.7% at
-    # seq 1024 (measured, 1.3B A/B on one v5e chip), so "auto" enables it
-    # only where the saved memory is material: when the logits slab
-    # (tokens x vocab x itemsize for the global batch) reaches 1 GB —
-    # long sequences or 100k+ vocabularies. An int >= 1 enables it with
-    # that token chunk size (default 2048); 0/False disable.
+    # logits. True | False | "auto". The chunked head scan has a real
+    # cost — measured on one v5e chip: ~0.7% at seq 1024 (1.3B A/B) and
+    # 1.5x step time at seq 16k/125M where full remat + chunked attention
+    # mean logits were not the binding buffer anyway — so "auto" engages
+    # only when the slab (tokens x vocab x itemsize, global batch) reaches
+    # 4 GB. There it WINS: a 256k-vocab model (seq 4096) measures 2.5%
+    # faster at micro 2 (4.3 GB slab) and 7% at micro 4 (8.6 GB) than the
+    # dense head, with identical losses.
+    # An int >= 1 forces it with that token chunk size (default 2048);
+    # 0/False disable.
     fused_head_ce: Any = "auto"
     # MoE (reference deepspeed/moe/): 0 experts = dense MLP everywhere
     moe_num_experts: int = 0
@@ -710,7 +714,7 @@ class GPT(nn.Module):
         if fused == "auto":
             logits_bytes = (B * T * cfg.vocab_size
                             * jnp.dtype(cfg.dtype).itemsize)
-            fused = logits_bytes >= (1 << 30)
+            fused = logits_bytes >= (4 << 30)
         if fused:
             # fused head+CE: [tokens, vocab] logits never materialize —
             # the head runs chunk-by-chunk inside the loss vjp

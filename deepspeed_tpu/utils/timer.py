@@ -16,18 +16,9 @@ _fence_fn = None
 
 
 def _sync():
-    """Fence the async dispatch queue. A tiny *jitted computation* is enqueued
-    on the device compute stream (which executes programs in order) and blocked
-    on — a bare device_put would complete via DMA without waiting for pending
-    programs."""
-    global _fence_fn
+    """Timer-internal fence; never raises (timers must work device-less)."""
     try:
-        import jax
-        import jax.numpy as jnp
-
-        if _fence_fn is None:
-            _fence_fn = jax.jit(lambda: jnp.zeros(()))
-        jax.block_until_ready(_fence_fn())
+        fence()
     except Exception:  # pragma: no cover
         pass
 
@@ -37,16 +28,18 @@ def fence(tree=None):
 
     ``block_until_ready`` can return BEFORE the accelerator queue drains on
     tunneled transports, so fence with a scalar HOST READ of a device-side
-    reduction — of the first leaf of ``tree`` (e.g. ``engine.params``) if
-    given, else of a fresh tiny program enqueued behind everything pending.
-    Never read a full array as a fence: the transfer poisons the timing.
+    reduction — of one element of the first leaf of ``tree`` (e.g.
+    ``engine.params``) if given, else of a fresh tiny program enqueued
+    behind everything pending (the device runs programs in order). Never
+    read a full array as a fence: the transfer poisons the timing — and a
+    full-leaf f32 upcast would allocate at the worst possible moment.
     """
     import jax
     import jax.numpy as jnp
 
     leaves = jax.tree.leaves(tree) if tree is not None else []
     if leaves:
-        float(jnp.sum(leaves[0].astype(jnp.float32)))
+        float(jnp.sum(leaves[0].ravel()[:1].astype(jnp.float32)))
         return
     global _fence_fn
     if _fence_fn is None:
