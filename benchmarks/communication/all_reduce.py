@@ -1,0 +1,10 @@
+#!/usr/bin/env python
+"""all_reduce bandwidth sweep (reference benchmarks/communication/all_reduce.py);
+thin entry over run_all.py — same flags."""
+import sys
+
+import run_all
+
+if __name__ == "__main__":
+    sys.argv.insert(1, "--ops=all_reduce")
+    run_all.main()

@@ -1,0 +1,10 @@
+#!/usr/bin/env python
+"""all_to_all bandwidth sweep (reference benchmarks/communication/all_to_all.py);
+thin entry over run_all.py — same flags."""
+import sys
+
+import run_all
+
+if __name__ == "__main__":
+    sys.argv.insert(1, "--ops=all_to_all")
+    run_all.main()

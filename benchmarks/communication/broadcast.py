@@ -1,0 +1,10 @@
+#!/usr/bin/env python
+"""broadcast bandwidth sweep (reference benchmarks/communication/broadcast.py);
+thin entry over run_all.py — same flags."""
+import sys
+
+import run_all
+
+if __name__ == "__main__":
+    sys.argv.insert(1, "--ops=broadcast")
+    run_all.main()

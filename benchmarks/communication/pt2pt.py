@@ -1,0 +1,10 @@
+#!/usr/bin/env python
+"""Point-to-point (neighbour ppermute) sweep (reference
+benchmarks/communication/pt2pt.py); thin entry over run_all.py."""
+import sys
+
+import run_all
+
+if __name__ == "__main__":
+    sys.argv.insert(1, "--ops=ppermute")
+    run_all.main()

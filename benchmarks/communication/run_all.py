@@ -52,7 +52,7 @@ def main():
     print(f"# {n} x {devs[0].device_kind}")
 
     KNOWN_OPS = ("all_reduce", "all_gather", "reduce_scatter",
-                 "all_to_all", "ppermute")
+                 "all_to_all", "ppermute", "broadcast")
 
     def make(op):
         if op not in KNOWN_OPS:
@@ -74,6 +74,10 @@ def main():
             elif op == "ppermute":
                 r = jax.lax.ppermute(
                     x, "x", [(i, (i + 1) % n) for i in range(n)])
+            elif op == "broadcast":
+                # root-0 broadcast as a masked psum (comm/comm.py broadcast)
+                r = jax.lax.psum(
+                    jnp.where(jax.lax.axis_index("x") == 0, x, 0), "x")
             return jnp.sum(r, keepdims=True)[None]
 
         return jax.jit(shard_map(
