@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Collective bandwidth sweeps (reference benchmarks/communication/*):
-all_reduce / all_gather / reduce_scatter / all_to_all / ppermute over the
-mesh, reporting algbw and busbw per payload size.
+all_reduce / all_gather / reduce_scatter / all_to_all / ppermute /
+broadcast over the mesh, reporting algbw and busbw per payload size.
 
 Run on real hardware (single chip: loopback numbers) or the virtual CPU
 mesh:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -19,7 +19,9 @@ def busbw_factor(op: str, n: int) -> float:
     byte of payload, gather/scatter (n-1)/n."""
     if n <= 1:
         return 1.0
-    if op == "all_reduce":
+    if op in ("all_reduce", "broadcast"):
+        # broadcast lowers to a masked psum here (comm/comm.py), so its
+        # wire traffic is allreduce-shaped, not optimal-broadcast-shaped
         return 2 * (n - 1) / n
     if op in ("all_gather", "reduce_scatter", "all_to_all"):
         return (n - 1) / n
@@ -31,7 +33,7 @@ def main():
     p.add_argument("--backend", default=None, choices=[None, "cpu"],
                    help="cpu = force the virtual host-device mesh")
     p.add_argument("--ops", default="all_reduce,all_gather,"
-                   "reduce_scatter,all_to_all,ppermute")
+                   "reduce_scatter,all_to_all,ppermute,broadcast")
     p.add_argument("--min-bytes", type=int, default=1 << 16)
     p.add_argument("--max-bytes", type=int, default=1 << 26)
     p.add_argument("--iters", type=int, default=10)

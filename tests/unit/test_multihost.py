@@ -17,6 +17,14 @@ training program whose collectives span the process boundary:
 * ``sp_ring`` — ring-attention sequence parallelism over sp=8: the KV ring
   ppermute hops between hosts every attention step — the long-context
   distributed path (absent in the reference snapshot; SURVEY §2.2).
+* ``moe_ep`` — top-2 MoE over ep=8: the expert-parallel group spans BOTH
+  processes (ep must be the full 8 devices: with dp outermost in
+  AXIS_ORDER, any dp>1 split would leave each ep group intra-process),
+  so the expert-dispatch all-to-all crosses hosts (reference
+  moe/sharded_moe.py _AllToAll over the expert-parallel group).
+
+With these five, every parallel mesh axis (dp, fsdp, tp, sp, ep) runs its
+collectives across a real process boundary.
 
 Each child's loss stream is compared against a single-process 8-device run
 of the identical scenario, so cross-host execution is held to numerical
@@ -106,6 +114,15 @@ def run_case(name):
                               param_dtype=jnp.float32,
                               sequence_parallel="ring"))
         it = _token_batches(2)
+    elif name == "moe_ep":
+        from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+        cfg = dict(base, train_micro_batch_size_per_gpu=2,
+                   tpu={"mesh": {"dp": 1, "ep": 8}})
+        model = GPT(GPTConfig(vocab_size=128, n_positions=32, n_embd=32,
+                              n_layer=2, n_head=4, dtype=jnp.float32,
+                              param_dtype=jnp.float32, scan_layers=False,
+                              moe_num_experts=8, moe_top_k=2))
+        it = _token_batches(16)  # dp_size = ep = 8; micro 2 each
     else:
         raise ValueError(name)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
@@ -200,7 +217,8 @@ def _spawn_pair(case, tmp_path):
     return per_proc
 
 
-@pytest.mark.parametrize("case", ["stage2", "stage3", "tp8", "sp_ring"])
+@pytest.mark.parametrize("case", ["stage2", "stage3", "tp8", "sp_ring",
+                                  "moe_ep"])
 def test_two_process_training_matches_single_host(case, eight_devices,
                                                   tmp_path):
     losses_ref = _single_process_reference(case)
