@@ -4,6 +4,11 @@ prefill + per-token decode p50/p90 latency and tokens/sec for a GPT config
 through deepspeed_tpu.init_inference.
 
   python benchmarks/inference/gpt_bench.py --model gpt2-125m --tokens 64
+
+Measured r3 (gpt2-125m bf16, 128-token prompt, 64 new tokens, one v5e over
+the dev tunnel, scan-decode chunk 32): batch 1 — 2.8 ms/token p50, 353
+tokens/sec; batch 8 — 3.34 ms/step, 2392 tokens/sec; batch 32 — 6.92
+ms/step, 4623 tokens/sec.
 """
 
 import argparse
