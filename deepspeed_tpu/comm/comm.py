@@ -180,6 +180,43 @@ def init_distributed(
         # single-host no-op; do NOT latch _initialized so a later call with
         # real coordinator args still performs the rendezvous
         return
+    # enable jax's cross-host device-transfer server (PjRt DCN path) so
+    # host-level cross-mesh device_puts — the pipeline engine's inter-stage
+    # transfers — work across hosts. Must be configured BEFORE the backend
+    # initialises. DS_TPU_TRANSFER_ADDR overrides the advertised address
+    # (set it empty to disable).
+    addr = os.environ.get("DS_TPU_TRANSFER_ADDR")
+    if addr is None:
+        # the reachable local IP is the one that routes to the coordinator
+        # (gethostbyname(gethostname()) is a loopback trap on hosts whose
+        # /etc/hosts maps the hostname to 127.0.x.1): a connected UDP
+        # socket picks the right interface without sending anything
+        import socket
+
+        addr = ""
+        if coordinator_address:
+            try:
+                probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                try:
+                    probe.connect(
+                        (coordinator_address.rpartition(":")[0], 9))
+                    addr = f"{probe.getsockname()[0]}:0"
+                finally:
+                    probe.close()
+            except OSError:
+                addr = ""
+    if addr:
+        try:
+            jax.config.update("jax_cross_host_transfer_socket_address", addr)
+        except Exception as e:
+            # missing flag (old jax) or malformed address: cross-host
+            # device_puts (pipeline inter-stage) will not work — say so
+            # instead of hanging silently later
+            logger.warning(
+                f"cross-host transfer server not configured ({e}); "
+                "host-level cross-mesh transfers (pipeline pp across "
+                "hosts) will be unavailable")
+
     # log_dist is unusable before the rendezvous: it queries
     # jax.process_index(), which initialises the XLA backend and makes
     # jax.distributed.initialize fail — use the raw logger here so a

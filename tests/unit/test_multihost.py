@@ -22,9 +22,13 @@ training program whose collectives span the process boundary:
   AXIS_ORDER, any dp>1 split would leave each ep group intra-process),
   so the expert-dispatch all-to-all crosses hosts (reference
   moe/sharded_moe.py _AllToAll over the expert-parallel group).
-
-With these five, every parallel mesh axis (dp, fsdp, tp, sp, ep) runs its
-collectives across a real process boundary.
+With these five, every compiled-collective mesh axis (dp, fsdp, tp, sp,
+ep) runs across a real process boundary. Pipeline (pp) inter-stage
+transfers are host-level cross-mesh device_puts — on a real pod they ride
+jax's DCN transfer path (``jax_cross_host_transfer_socket_address``); the
+CPU backend's transfer server cannot emulate that here (verified: the
+flagged path hangs on the virtual mesh), so multi-host pp is exercised by
+the driver's TPU-side dryrun instead.
 
 Each child's loss stream is compared against a single-process 8-device run
 of the identical scenario, so cross-host execution is held to numerical
