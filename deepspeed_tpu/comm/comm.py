@@ -194,12 +194,19 @@ def init_distributed(
         import socket
 
         addr = ""
-        if coordinator_address:
+        host = (coordinator_address or "").strip()
+        if host.startswith("["):          # [v6]:port or [v6]
+            host = host[1:].split("]", 1)[0]
+        elif host.count(":") == 1:        # host:port
+            host = host.rpartition(":")[0]
+        # else: port-less hostname/IPv4, or bare IPv6 — use as-is
+        if host:
             try:
-                probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                family = (socket.AF_INET6 if ":" in host
+                          else socket.AF_INET)
+                probe = socket.socket(family, socket.SOCK_DGRAM)
                 try:
-                    probe.connect(
-                        (coordinator_address.rpartition(":")[0], 9))
+                    probe.connect((host, 9))
                     addr = f"{probe.getsockname()[0]}:0"
                 finally:
                     probe.close()
@@ -216,6 +223,13 @@ def init_distributed(
                 f"cross-host transfer server not configured ({e}); "
                 "host-level cross-mesh transfers (pipeline pp across "
                 "hosts) will be unavailable")
+    elif os.environ.get("DS_TPU_TRANSFER_ADDR") is None:
+        # not explicitly disabled, yet no address could be derived (e.g.
+        # pod auto-detection with no coordinator given, or probe failure)
+        logger.warning(
+            "could not derive a cross-host transfer address; pipeline "
+            "inter-stage transfers across hosts will be unavailable — "
+            "set DS_TPU_TRANSFER_ADDR=<this_host_ip>:0 to enable them")
 
     # log_dist is unusable before the rendezvous: it queries
     # jax.process_index(), which initialises the XLA backend and makes

@@ -174,6 +174,13 @@ class ThroughputTimer:
             # the dispatch pipeline (one fence costs a full in-flight step).
             # Throughput is fenced only at reporting boundaries, so the
             # running average is exact and intermediate steps overlap.
+            if self._fence_epoch_time is None:
+                # seed the fenced baseline once, so the FIRST report
+                # already has a span to measure against (it used to print
+                # 0.000 until the second reporting boundary)
+                _sync()
+                self._fence_epoch_time = time.time()
+                self._fence_epoch_step = self.global_step_count
             self.start_time = time.time()
 
     def stop(self, global_step: bool = False, report_speed: bool = True):
