@@ -193,6 +193,13 @@ class ThroughputTimer:
             # running average is exact and intermediate steps overlap.
             self.start_time = time.time()
 
+    def _reseed_fence_epoch(self):
+        """Drain the device queue and (re)anchor the fenced wall-clock
+        baseline at the current step count."""
+        _sync()
+        self._fence_epoch_time = time.time()
+        self._fence_epoch_step = self.global_step_count
+
     def stop(self, global_step: bool = False, report_speed: bool = True):
         if not self.started:
             return
@@ -207,9 +214,7 @@ class ThroughputTimer:
                 # the last warmup step, so the drain (which waits out every
                 # in-flight compile/step) is never charged to the first
                 # measured interval
-                _sync()
-                self._fence_epoch_time = time.time()
-                self._fence_epoch_step = self.global_step_count
+                self._reseed_fence_epoch()
         if self.start_time > 0:
             self.end_time = time.time()
             duration = self.end_time - self.start_time
@@ -222,18 +227,17 @@ class ThroughputTimer:
                 # steps in between are dispatch-only (no fence); honest
                 # throughput = samples between fenced boundaries / the
                 # fenced wall time between them
-                _sync()
-                now = time.time()
+                prev_time, prev_step = (self._fence_epoch_time,
+                                        self._fence_epoch_step)
+                self._reseed_fence_epoch()
                 curr = 0.0
-                if self._fence_epoch_time is not None:
-                    span = now - self._fence_epoch_time
-                    steps = self.global_step_count - self._fence_epoch_step
+                if prev_time is not None:
+                    span = self._fence_epoch_time - prev_time
+                    steps = self.global_step_count - prev_step
                     if span > 0:
                         curr = self.batch_size * steps / span
                     self._fenced_total_time += span
                     self._fenced_total_steps += steps
-                self._fence_epoch_time = now
-                self._fence_epoch_step = self.global_step_count
                 self.logging(
                     "epoch={}/micro_step={}/global_step={}, "
                     "RunningAvgSamplesPerSec={:.3f}, CurrSamplesPerSec={:.3f}".format(
