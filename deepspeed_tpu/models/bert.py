@@ -39,6 +39,12 @@ class BertConfig:
     # trunk; see transformer_lm._remat_policy)
     remat_policy: str = "full"
     scan_layers: bool = True
+    # a SparsityConfig (ops.sparse_attention): restricts attention to the
+    # config's block layout (default impl: static K/V-block gather + MXU
+    # einsums; "kernel": "pallas" selects the streaming kernel). Populated
+    # from the DeepSpeed "sparse_attention" config block by
+    # sparse_attention_utils.apply_sparse_attention.
+    sparse_attention: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -73,12 +79,29 @@ class BertSelfAttention(nn.Module):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
-        if mask is not None:
-            att = jnp.where(mask[:, None, None, :], att, jnp.finfo(att.dtype).min)
-        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
-        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        if cfg.sparse_attention is not None:
+            # block-sparse path (SparseSelfAttention; default "gather" impl
+            # materializes [B, H, nL, block, W*block] score buffers).
+            # Attention-probability dropout is not applied on this path —
+            # the layout already drops most of the attention matrix; output
+            # dropout below still applies.
+            from deepspeed_tpu.ops.sparse_attention import SparseSelfAttention
+
+            sa = SparseSelfAttention(
+                cfg.sparse_attention,
+                max_seq_length=cfg.max_position_embeddings)
+            kpm = None
+            if mask is not None:
+                kpm = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)
+            y = sa(q, k, v, key_padding_mask=kpm).reshape(B, T, C)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+            if mask is not None:
+                att = jnp.where(mask[:, None, None, :], att,
+                                jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
         y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      name="output")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)

@@ -21,4 +21,5 @@ from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (  # noqa: 
     SparseSelfAttention,
     block_sparse_attention,
     dense_blocksparse_attention,
+    gathered_blocksparse_attention,
 )

@@ -1,0 +1,138 @@
+"""Config-to-model wiring for block-sparse attention.
+
+Capability counterpart of reference
+``deepspeed/ops/sparse_attention/sparse_attention_utils.py:1-126``
+(SparseAttentionUtils: swap a model's self-attention for
+SparseSelfAttention, pad/unpad inputs to the block size) and the
+``sparse_attention`` config block parsing at reference
+``deepspeed/runtime/config.py:283-466``.
+
+The TPU-native shape of "replace the attention module": our models are
+flax dataclass-configured, so instead of monkey-patching torch submodules
+the model's *config* carries an optional ``sparse_attention`` field
+(a :class:`SparsityConfig`), and the attention module routes on it at
+trace time. :func:`apply_sparse_attention` returns a rebuilt model with
+that field populated; ``deepspeed_tpu.initialize`` calls it automatically
+when the DeepSpeed config has a ``sparse_attention`` block.
+"""
+
+import dataclasses
+import inspect
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+)
+
+# reference runtime/config.py:283 SPARSE_*_MODE constants
+SPARSE_MODE_KEY = "mode"
+SPARSE_DENSE_MODE = "dense"
+SPARSE_FIXED_MODE = "fixed"
+SPARSE_VARIABLE_MODE = "variable"
+SPARSE_BIGBIRD_MODE = "bigbird"
+SPARSE_BSLONGFORMER_MODE = "bslongformer"
+SPARSE_LOCAL_SLIDING_WINDOW_MODE = "local_sliding_window"
+
+_MODE_TO_CONFIG = {
+    SPARSE_DENSE_MODE: DenseSparsityConfig,
+    SPARSE_FIXED_MODE: FixedSparsityConfig,
+    SPARSE_VARIABLE_MODE: VariableSparsityConfig,
+    SPARSE_BIGBIRD_MODE: BigBirdSparsityConfig,
+    SPARSE_BSLONGFORMER_MODE: BSLongformerSparsityConfig,
+    SPARSE_LOCAL_SLIDING_WINDOW_MODE: LocalSlidingWindowSparsityConfig,
+}
+
+
+def get_sparse_attention_config(param_dict: dict,
+                                num_heads: int) -> SparsityConfig:
+    """Build a :class:`SparsityConfig` from a DeepSpeed ``sparse_attention``
+    config block (reference runtime/config.py:427 get_sparse_attention).
+
+    ``num_heads`` comes from the model, not the JSON — the reference takes
+    it at module-construction time the same way.
+    """
+    if isinstance(param_dict, SparsityConfig):
+        return param_dict
+    params = dict(param_dict or {})
+    mode = params.pop(SPARSE_MODE_KEY, SPARSE_FIXED_MODE)
+    # implementation selector, not a layout parameter: "gather" (default,
+    # XLA static-gather + MXU einsums), "pallas" (streaming kernel), or
+    # "dense" (masked full attention, for testing)
+    kernel_impl = params.pop("kernel", None)
+    cls = _MODE_TO_CONFIG.get(mode)
+    if cls is None:
+        raise NotImplementedError(
+            f"sparse_attention mode '{mode}' is not supported; choose from "
+            f"{sorted(_MODE_TO_CONFIG)}")
+    accepted = set(inspect.signature(cls.__init__).parameters) - {"self"}
+    unknown = set(params) - accepted
+    if unknown:
+        raise ValueError(
+            f"sparse_attention ({mode}): unknown keys {sorted(unknown)}; "
+            f"accepted: {sorted(accepted - {'num_heads'})}")
+    sc = cls(num_heads=num_heads, **params)
+    if kernel_impl is not None:
+        if kernel_impl not in ("gather", "pallas", "dense"):
+            raise ValueError(
+                f"sparse_attention kernel must be 'gather', 'pallas' or "
+                f"'dense', got '{kernel_impl}'")
+        sc.kernel_impl = kernel_impl
+    return sc
+
+
+def apply_sparse_attention(model, sparse_config):
+    """Return ``model`` rebuilt with block-sparse attention enabled.
+
+    ``sparse_config`` is the DeepSpeed ``sparse_attention`` dict (or an
+    already-built :class:`SparsityConfig`). The model's config dataclass
+    must expose a ``sparse_attention`` field and a ``num_attention_heads``
+    (or ``n_head``) count — BERT-style encoders here, matching the
+    reference's supported-model list
+    (sparse_attention_utils.py:37 replace_model_self_attention).
+    """
+    cfg = getattr(model, "config", None)
+    if cfg is None or not any(f.name == "sparse_attention"
+                              for f in dataclasses.fields(cfg)):
+        raise NotImplementedError(
+            f"{type(model).__name__} does not support sparse attention "
+            f"injection (its config has no 'sparse_attention' field); "
+            f"supported: BertForPreTraining and models sharing its encoder")
+    num_heads = getattr(cfg, "num_attention_heads",
+                        getattr(cfg, "n_head", None))
+    sc = get_sparse_attention_config(sparse_config, num_heads)
+    new_cfg = dataclasses.replace(cfg, sparse_attention=sc)
+    return model.clone(config=new_cfg)
+
+
+def pad_to_block_size(block: int, input_ids, attention_mask=None,
+                      pad_token_id: int = 0):
+    """Pad ``[B, T]`` token inputs on the right so T is a block multiple
+    (reference sparse_attention_utils.py:84 pad_to_block_size). Returns
+    ``(pad_len, input_ids, attention_mask)``; padded keys are masked out.
+    """
+    t = input_ids.shape[1]
+    pad_len = (-t) % block
+    if pad_len == 0:
+        return 0, input_ids, attention_mask
+    pad = [(0, 0), (0, pad_len)]
+    input_ids = jnp.pad(input_ids, pad, constant_values=pad_token_id)
+    if attention_mask is None:
+        attention_mask = jnp.ones((input_ids.shape[0], t), dtype=bool)
+    attention_mask = jnp.pad(attention_mask.astype(bool), pad,
+                             constant_values=False)
+    return pad_len, input_ids, attention_mask
+
+
+def unpad_sequence_output(pad_len: int, sequence_output):
+    """Strip padding added by :func:`pad_to_block_size` from ``[B, T, ...]``
+    model output (reference sparse_attention_utils.py:126)."""
+    if pad_len == 0:
+        return sequence_output
+    return sequence_output[:, :-pad_len]

@@ -222,8 +222,9 @@ def _build_op(layout, num_heads, scale, causal, block):
     kidx, n_k, qidx, n_q = _build_index_tables(layout, num_heads)
     h, nq, _, width_k = kidx.shape
     _, nk, _, width_q = qidx.shape
-    kidx_c = jnp.asarray(kidx)
-    qidx_c = jnp.asarray(qidx)
+    # keep the index tables as NUMPY in the closure: ops are cached across
+    # traces, and a jnp conversion done while some jit is tracing would bake
+    # that trace's tracer into the cache (leaks into every later trace)
 
     def fwd(q, k, v):
         b, t, heads, d = q.shape
@@ -253,7 +254,7 @@ def _build_op(layout, num_heads, scale, causal, block):
                 jax.ShapeDtypeStruct((bh, t, LSE_LANES), jnp.float32),
             ],
             interpret=_interpret(),
-        )(flat(q), flat(k), flat(v), kidx_c)
+        )(flat(q), flat(k), flat(v), jnp.asarray(kidx))
         return o, lse
 
     @jax.custom_vjp
@@ -302,7 +303,7 @@ def _build_op(layout, num_heads, scale, causal, block):
             out_specs=pl.BlockSpec((None, block, d), lambda i, j: (i, j, 0)),
             out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             interpret=_interpret(),
-        )(qf, kf, vf, dof, lse, delta, kidx_c)
+        )(qf, kf, vf, dof, lse, delta, jnp.asarray(kidx))
 
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -327,7 +328,7 @@ def _build_op(layout, num_heads, scale, causal, block):
                 jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             ],
             interpret=_interpret(),
-        )(qf, kf, vf, dof, lse, delta, qidx_c)
+        )(qf, kf, vf, dof, lse, delta, jnp.asarray(qidx))
 
         def unflat(x):
             return x.reshape(b, heads, t, d).transpose(0, 2, 1, 3)
@@ -361,6 +362,193 @@ def block_sparse_attention(q, k, v, layout, *, block: int,
     else:
         _OP_CACHE.move_to_end(key)
     return op(q, k, v)
+
+
+def _partition_rows(counts: np.ndarray, nk: int):
+    """Split query-block rows into a LIGHT set (narrow, gather path) and a
+    HEAVY set (wide, dense path) minimizing total key-block work.
+
+    Sparsity layouts are bimodal: banded rows touch a handful of blocks
+    while "global" rows (BigBird/Longformer global tokens, fixed-pattern
+    summary blocks) touch every block. A single gather table padded to the
+    max row width silently degenerates to dense-everything, so pick the
+    width cutoff that minimizes ``W_light * n_light + nk * n_heavy``,
+    where ``nk`` is the TOTAL key-block count a dense-path row pays for.
+    ``counts`` is the per-row active-block count, max-reduced over head
+    layouts. Returns (light_rows, heavy_rows) as sorted index arrays.
+    """
+    nq = counts.shape[0]
+    order = np.argsort(counts)           # ascending width
+    sorted_counts = counts[order]
+    best_cost, best_split = None, nq     # split = first heavy position
+    for split in range(nq + 1):
+        w_light = int(sorted_counts[split - 1]) if split else 0
+        cost = w_light * split + (nq - split) * nk
+        if best_cost is None or cost < best_cost:
+            best_cost, best_split = cost, split
+    light = np.sort(order[:best_split])
+    heavy = np.sort(order[best_split:])
+    return light, heavy
+
+
+def _compact_index_tables(layout: np.ndarray, rows: np.ndarray):
+    """Active key-block lists for the given rows, at their TRUE max width
+    (no lane padding — the gather path's cost is linear in this width).
+    ``layout`` is [hL, nq, nk]; returns ``idx [hL, len(rows), W]`` int32,
+    -1 padded."""
+    h_layout = layout.shape[0]
+    width = max(int(layout[:, rows].sum(axis=-1).max()), 1) if len(rows) \
+        else 1
+    out = np.full((h_layout, len(rows), width), -1, dtype=np.int32)
+    for h in range(h_layout):
+        for j, r in enumerate(rows):
+            nz = np.nonzero(layout[h, r])[0]
+            out[h, j, :len(nz)] = nz
+    return out
+
+
+def gathered_blocksparse_attention(q, k, v, layout, *, block: int,
+                                   causal: bool = False, scale: float = None,
+                                   key_padding_mask=None, attn_mask=None,
+                                   key_padding_mask_mode: str = "add",
+                                   attn_mask_mode: str = "mul"):
+    """XLA-native block-sparse attention: gather each query row's active
+    K/V blocks with STATIC indices, then dense batched einsums over the
+    gathered width; wide "global" rows are split off and computed densely.
+
+    The TPU-first formulation of the reference's Triton SDD/DSD launches
+    (``ops/sparse_attention/matmul.py:212``): on TPU the win comes from
+    keeping the contraction on the MXU — a static gather feeding batched
+    [block, W*block] matmuls runs at matmul rate, while a hand-scheduled
+    streaming kernel is DMA-latency-bound. Autodiff works through it (XLA
+    emits the gather transpose), element masks fold in by gathering mask
+    blocks with the same indices, and the light/heavy row split keeps one
+    BigBird global row from padding the whole table to dense.
+    """
+    b, t, heads, d = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    layout = np.asarray(layout)
+    h_layout, nq, nk = layout.shape
+    if h_layout not in (1, heads):
+        raise ValueError(
+            f"layout has {h_layout} head layouts; expected 1 or {heads}")
+    if t != nq * block:
+        raise ValueError(
+            f"layout covers {nq * block} positions, inputs have {t}")
+
+    counts = layout.sum(axis=-1).max(axis=0)          # [nq], max over heads
+    light_rows, heavy_rows = _partition_rows(counts, nk)
+
+    dtype = q.dtype
+    neg = jnp.float32(NEG_INF)
+    # block views: [B, H, n, block, D]
+    qb = q.reshape(b, nq, block, heads, d).transpose(0, 3, 1, 2, 4)
+    kb = k.reshape(b, nq, block, heads, d).transpose(0, 3, 1, 2, 4)
+    vb = v.reshape(b, nq, block, heads, d).transpose(0, 3, 1, 2, 4)
+    kpb = None
+    if key_padding_mask is not None:
+        kpb = jnp.asarray(key_padding_mask).reshape(b, nq, block)
+    amp = None
+    if attn_mask is not None:
+        am = jnp.asarray(attn_mask)                   # [T, T]
+        amp = am.reshape(nq, block, nq, block)
+
+    def softmax_rows(s, row_shape):
+        """Masked softmax over the flattened key axes, NaN-safe for rows
+        whose every key is masked (possible under padding masks)."""
+        sf = s.reshape(row_shape)
+        m = jnp.max(sf, axis=-1, keepdims=True)
+        e = jnp.exp(sf - jax.lax.stop_gradient(jnp.maximum(m, neg / 2)))
+        denom = jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+        return (e / denom).astype(dtype).reshape(s.shape)
+
+    def apply_kpm(s, kp):                              # kp: [B, ..., block]
+        if key_padding_mask_mode == "mul":
+            return jnp.where(kp > 0, s, neg)
+        return s + kp.astype(jnp.float32)
+
+    def apply_am(s, am_part):
+        if attn_mask_mode == "mul":
+            return jnp.where(am_part > 0, s, neg)
+        return s + am_part.astype(jnp.float32)
+
+    out_parts, out_rows = [], []
+
+    if len(light_rows):
+        idx = _compact_index_tables(layout, light_rows)  # [hL, nL, W] static
+        w = idx.shape[-1]
+        nl = len(light_rows)
+        gidx = jnp.asarray(np.maximum(idx, 0))
+        ql = qb[:, :, light_rows]                     # [B, H, nL, block, D]
+        if h_layout == 1:
+            kg = kb[:, :, gidx[0]]                    # [B, H, nL, W, block, D]
+            vg = vb[:, :, gidx[0]]
+        else:
+            gather = jax.vmap(lambda xb_h, idx_h: xb_h[:, idx_h],
+                              in_axes=(1, 0), out_axes=1)
+            kg = gather(kb, gidx)
+            vg = gather(vb, gidx)
+        s = jnp.einsum("bhqid,bhqwjd->bhqiwj", ql, kg,
+                       preferred_element_type=jnp.float32) * scale
+        valid = idx >= 0                              # [hL, nL, W] static
+        s = jnp.where(jnp.asarray(valid)[None, :, :, None, :, None], s, neg)
+        if causal:
+            q_pos = (light_rows[:, None] * block
+                     + np.arange(block)[None, :])     # [nL, block]
+            k_pos = idx[..., None] * block + np.arange(block)
+            cm = (k_pos[:, :, None, :, :]
+                  <= q_pos[None, :, :, None, None])   # [hL,nL,block,W,block]
+            s = jnp.where(jnp.asarray(cm)[None], s, neg)
+        if amp is not None:
+            flat = amp.transpose(0, 2, 1, 3).reshape(nq * nq, block, block)
+            pair = light_rows[None, :, None] * nq + np.maximum(idx, 0)
+            am_g = flat[jnp.asarray(pair)]            # [hL,nL,W,block,block]
+            s = apply_am(s, am_g.transpose(0, 1, 3, 2, 4)[None])
+        if kpb is not None:
+            if h_layout == 1:
+                kp_g = kpb[:, gidx[0]][:, None]       # [B,1,nL,W,block]
+            else:
+                kp_g = jax.vmap(lambda idx_h: kpb[:, idx_h])(gidx)
+                kp_g = kp_g.transpose(1, 0, 2, 3, 4)
+            s = apply_kpm(s, kp_g[:, :, :, None])
+        p = softmax_rows(s, (b, heads, nl, block, w * block))
+        o = jnp.einsum("bhqiwj,bhqwjd->bhqid", p, vg)
+        out_parts.append(o)
+        out_rows.append(light_rows)
+
+    if len(heavy_rows):
+        nh = len(heavy_rows)
+        qh = qb[:, :, heavy_rows]                     # [B, H, nH, block, D]
+        s = jnp.einsum("bhrid,bhnjd->bhrinj", qh, kb,
+                       preferred_element_type=jnp.float32) * scale
+        row_mask = layout[:, heavy_rows].astype(bool)  # [hL, nH, nk] static
+        s = jnp.where(jnp.asarray(row_mask)[None, :, :, None, :, None],
+                      s, neg)
+        if causal:
+            q_pos = (heavy_rows[:, None] * block
+                     + np.arange(block)[None, :])     # [nH, block]
+            k_pos = (np.arange(nk)[:, None] * block
+                     + np.arange(block)[None, :])     # [nk, block]
+            cm = (k_pos[None, None, :, :]
+                  <= q_pos[:, :, None, None])         # [nH, block, nk, block]
+            s = jnp.where(jnp.asarray(cm)[None, None], s, neg)
+        if amp is not None:
+            am_h = amp[heavy_rows]                    # [nH, block, nq, block]
+            s = apply_am(s, am_h[None, None])
+        if kpb is not None:
+            s = apply_kpm(s, kpb[:, None, None, None])
+        p = softmax_rows(s, (b, heads, nh, block, nk * block))
+        o = jnp.einsum("bhrinj,bhnjd->bhrid", p, vb)
+        out_parts.append(o)
+        out_rows.append(heavy_rows)
+
+    o = out_parts[0] if len(out_parts) == 1 else \
+        jnp.concatenate(out_parts, axis=2)
+    order = np.concatenate(out_rows)
+    if not np.array_equal(order, np.arange(nq)):
+        o = jnp.take(o, jnp.asarray(np.argsort(order)), axis=2)
+    return o.transpose(0, 2, 3, 1, 4).reshape(b, t, heads, d).astype(dtype)
 
 
 def dense_blocksparse_attention(q, k, v, layout, *, block: int,
@@ -414,7 +602,8 @@ class SparseSelfAttention:
     """
 
     def __init__(self, sparsity_config, key_padding_mask_mode: str = "add",
-                 attn_mask_mode: str = "mul", max_seq_length: int = 2048):
+                 attn_mask_mode: str = "mul", max_seq_length: int = 2048,
+                 impl: str = None):
         self.sparsity_config = sparsity_config
         if key_padding_mask_mode not in ("add", "mul"):
             raise ValueError("key_padding_mask_mode must be 'add' or 'mul'")
@@ -423,6 +612,18 @@ class SparseSelfAttention:
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
         self.max_seq_length = max_seq_length
+        # "gather" (default): static-index K/V block gather + dense batched
+        # einsums — keeps the contraction on the MXU and measures ~an order
+        # of magnitude faster than the streaming Pallas kernel on real
+        # chips (benchmarks/sparse_attention_results.json). "pallas": the
+        # streaming kernel (O(seq) memory, no gathered buffer — the choice
+        # when W*block activations don't fit). "dense": masked full
+        # attention, for testing.
+        if impl is None:
+            impl = getattr(sparsity_config, "kernel_impl", None) or "gather"
+        if impl not in ("gather", "pallas", "dense"):
+            raise ValueError("impl must be 'gather', 'pallas' or 'dense'")
+        self.impl = impl
         self._layouts = {}
 
     def get_layout(self, seq_len: int) -> np.ndarray:
@@ -441,12 +642,19 @@ class SparseSelfAttention:
         layout = self.get_layout(t)
         causal = getattr(self.sparsity_config, "attention",
                          "bidirectional") == "unidirectional"
-        if key_padding_mask is None and attn_mask is None:
+        block = self.sparsity_config.block
+        if self.impl == "gather":
+            return gathered_blocksparse_attention(
+                query, key, value, layout, block=block, causal=causal,
+                key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+                key_padding_mask_mode=self.key_padding_mask_mode,
+                attn_mask_mode=self.attn_mask_mode)
+        if self.impl == "pallas" and key_padding_mask is None \
+                and attn_mask is None:
             return block_sparse_attention(
-                query, key, value, layout,
-                block=self.sparsity_config.block, causal=causal)
+                query, key, value, layout, block=block, causal=causal)
         return dense_blocksparse_attention(
-            query, key, value, layout, block=self.sparsity_config.block,
+            query, key, value, layout, block=block,
             causal=causal, key_padding_mask=key_padding_mask,
             attn_mask=attn_mask,
             key_padding_mask_mode=self.key_padding_mask_mode,

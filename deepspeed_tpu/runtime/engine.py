@@ -248,12 +248,24 @@ class DeepSpeedEngine:
         initial_params=None,
         seed: int = 0,
     ):
-        self.module = model
         self._initial_params = initial_params
         if not isinstance(config, DeepSpeedConfig):
             # resolve triad after topology is known
             config = DeepSpeedConfig(config)
         self._config = config
+
+        if config.sparse_attention is not None:
+            # swap block-sparse attention into the model from config alone
+            # (reference sparse_attention_utils.py:37 replace_model_self_
+            # attention_with_sparse_self_attention)
+            from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+                import apply_sparse_attention
+
+            model = apply_sparse_attention(model, config.sparse_attention)
+            log_dist(
+                f"sparse attention enabled: "
+                f"{type(model.config.sparse_attention).__name__}", ranks=[0])
+        self.module = model
 
         if topology is None:
             topology = topology_from_config(config.tpu.mesh_config)
