@@ -45,6 +45,10 @@ class BertConfig:
     # from the DeepSpeed "sparse_attention" config block by
     # sparse_attention_utils.apply_sparse_attention.
     sparse_attention: Any = None
+    # stochastic transformer (reference op_builder/stochastic_transformer.py):
+    # whole-layer stochastic depth driven by the engine's PLD schedule; see
+    # transformer_lm.GPTConfig.stochastic_mode for the key/remat story
+    stochastic_mode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -112,8 +116,9 @@ class BertLayer(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True):
+    def __call__(self, x, mask=None, deterministic=True, pld_keep=None):
         cfg = self.config
+        x_in = x
         # Post-LN like original BERT
         a = BertSelfAttention(cfg, name="attention")(x, mask, deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_attn")(x + a)
@@ -124,6 +129,11 @@ class BertLayer(nn.Module):
                      param_dtype=cfg.param_dtype, name="output")(h)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="ln_out")(x + h)
+        if cfg.stochastic_mode and pld_keep is not None and not deterministic:
+            # whole-layer stochastic depth (PLD identity skip; same
+            # remat-exact per-layer key story as transformer_lm.Block)
+            gate = jax.random.bernoulli(self.make_rng("dropout"), pld_keep)
+            x = jnp.where(gate, x, x_in)
         return x
 
 
@@ -131,8 +141,20 @@ class BertEncoder(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True):
+    def __call__(self, x, mask=None, deterministic=True, pld_theta=None):
         cfg = self.config
+        L = cfg.num_hidden_layers
+        use_pld = (cfg.stochastic_mode and pld_theta is not None
+                   and not deterministic)
+
+        def keep_of(layer_idx):
+            if not use_pld:
+                return None
+            from deepspeed_tpu.models.transformer_lm import \
+                pld_keep_probability
+
+            return pld_keep_probability(layer_idx, L, pld_theta)
+
         if cfg.scan_layers:
             layer_cls = BertLayer
             if cfg.remat:
@@ -141,18 +163,21 @@ class BertEncoder(nn.Module):
                 layer_cls = nn.remat(BertLayer, prevent_cse=False,
                                      policy=_remat_policy(cfg.remat_policy))
 
-            def body(layer, carry):
+            def body(layer, carry, layer_idx):
                 x, mask = carry
-                return (layer(x, mask, deterministic), mask), None
+                return (layer(x, mask, deterministic,
+                              keep_of(layer_idx)), mask), None
 
             scanned = nn.scan(
                 body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
-                length=cfg.num_hidden_layers,
+                in_axes=0,
+                length=L,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            (x, _), _ = scanned(layer_cls(cfg, name="layer"), (x, mask))
+            (x, _), _ = scanned(layer_cls(cfg, name="layer"), (x, mask),
+                                jnp.arange(L))
             return x
         layer_cls = BertLayer
         if cfg.remat:
@@ -161,7 +186,8 @@ class BertEncoder(nn.Module):
             layer_cls = nn.remat(BertLayer, prevent_cse=False,
                                  policy=_remat_policy(cfg.remat_policy))
         for i in range(cfg.num_hidden_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask, deterministic,
+                                                  keep_of(i))
         return x
 
 
@@ -196,7 +222,7 @@ class BertForPreTraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 labels=None, deterministic=True):
+                 labels=None, deterministic=True, pld_theta=None):
         cfg = self.config
         B, T = input_ids.shape
         tok = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -212,7 +238,8 @@ class BertForPreTraining(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name="embeddings_ln")(x)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
-        x = BertEncoder(cfg, name="encoder")(x, attention_mask, deterministic)
+        x = BertEncoder(cfg, name="encoder")(x, attention_mask, deterministic,
+                                             pld_theta=pld_theta)
 
         # MLM transform + tied decoder
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,

@@ -98,6 +98,16 @@ class GPTConfig:
     # An int >= 1 forces it with that token chunk size (default 2048);
     # 0/False disable.
     fused_head_ce: Any = "auto"
+    # stochastic transformer (reference op_builder/stochastic_transformer.py,
+    # ops/transformer/transformer.py:110 stochastic_mode): whole-block
+    # stochastic depth. When training under a progressive-layer-drop
+    # schedule the engine feeds ``pld_theta`` (computed IN-GRAPH from the
+    # step counter — no per-step host transfer) and each layer i survives
+    # with p_i = 1 - (i/L)(1 - theta), gated by an explicit per-layer key
+    # from the scan's split rng stream. ``jax.remat`` replays the same key
+    # at recompute, so gradients stay exact — the determinism the CUDA
+    # kernel's stochastic mode gives up, for free.
+    stochastic_mode: bool = False
     # MoE (reference deepspeed/moe/): 0 experts = dense MLP everywhere
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -448,8 +458,10 @@ class Block(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True, decode=False):
+    def __call__(self, x, *, mask=None, deterministic=True, decode=False,
+                 pld_keep=None):
         cfg = self.config
+        x_in = x
         a = CausalSelfAttention(cfg, name="attn")(
             _norm(cfg, "ln_1")(x),
             mask=mask, deterministic=deterministic, decode=decode)
@@ -484,6 +496,14 @@ class Block(nn.Module):
             y = MLP(cfg, name="mlp")(h, deterministic=deterministic)
             l_aux = jnp.float32(0.0)
         x = x + y + a if cfg.parallel_residual else x + y
+        if cfg.stochastic_mode and pld_keep is not None and not deterministic:
+            # whole-block stochastic depth (PLD form: identity skip, no
+            # 1/keep rescale — inference uses all layers unscaled). The
+            # gate key comes from the per-layer split "dropout" stream, so
+            # remat recompute reproduces the same draw exactly.
+            gate = jax.random.bernoulli(self.make_rng("dropout"), pld_keep)
+            x = jnp.where(gate, x, x_in)
+            l_aux = jnp.where(gate, l_aux, jnp.zeros_like(l_aux))
         return x, l_aux
 
 
@@ -514,6 +534,17 @@ def alibi_slopes(n_head: int) -> np.ndarray:
     return np.asarray(slopes, np.float32)
 
 
+def pld_keep_probability(layer_idx, n_layer: int, theta):
+    """Depth schedule for PLD stochastic depth: layer i survives with
+    ``p_i = 1 - (i/L)(1 - theta)`` — deeper layers drop more. Shared by
+    the GPT trunk (scan + loop forms) and the BERT encoder so the schedule
+    cannot drift between them. ``layer_idx`` may be a python int or a
+    traced scan counter; ``theta`` a float or traced scalar."""
+    frac = (layer_idx.astype(jnp.float32)
+            if hasattr(layer_idx, "astype") else float(layer_idx)) / n_layer
+    return 1.0 - frac * (1.0 - theta)
+
+
 def _remat_policy(name: str):
     import jax
 
@@ -539,22 +570,27 @@ class ScannedBlocks(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True, decode=False):
+    def __call__(self, x, *, mask=None, deterministic=True, decode=False,
+                 pld_theta=None):
         cfg = self.config
+        use_pld = (cfg.stochastic_mode and pld_theta is not None
+                   and not deterministic)
 
-        def call_block(block, x, mask):
+        def call_block(block, x, mask, layer_idx):
             # deterministic/decode ride the closure so remat never sees
             # them as traced booleans
+            pld_keep = (pld_keep_probability(layer_idx, cfg.n_layer,
+                                             pld_theta) if use_pld else None)
             return block(x, mask=mask, deterministic=deterministic,
-                         decode=decode)
+                         decode=decode, pld_keep=pld_keep)
 
         if cfg.remat:
             call_block = nn.remat(call_block, prevent_cse=False,
                                   policy=_remat_policy(cfg.remat_policy))
 
-        def body(block, carry):
+        def body(block, carry, layer_idx):
             x, mask = carry
-            x, l_aux = call_block(block, x, mask)
+            x, l_aux = call_block(block, x, mask, layer_idx)
             return (x, mask), l_aux
 
         block_cls = Block
@@ -574,10 +610,12 @@ class ScannedBlocks(nn.Module):
             body,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True, "gating": True},
+            in_axes=0,
             length=cfg.n_layer,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), l_aux = scanned(block_cls(cfg, name="block"), (x, mask))
+        (x, _), l_aux = scanned(block_cls(cfg, name="block"), (x, mask),
+                                jnp.arange(cfg.n_layer))
         return x, jnp.sum(l_aux)
 
 
@@ -632,7 +670,7 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, attention_mask=None,
-                 deterministic=True, decode=False):
+                 deterministic=True, decode=False, pld_theta=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = VocabEmbed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
@@ -666,21 +704,25 @@ class GPT(nn.Module):
         if cfg.scan_layers:
             x, l_aux = ScannedBlocks(cfg, name="h")(
                 x, mask=attention_mask, deterministic=deterministic,
-                decode=decode)
+                decode=decode, pld_theta=pld_theta)
         else:
             l_aux = jnp.float32(0.0)
+            use_pld = (cfg.stochastic_mode and pld_theta is not None
+                       and not deterministic)
 
-            def call_block(block, x, mask):
+            def call_block(block, x, mask, pld_keep):
                 # closure keeps deterministic/decode static under remat
                 return block(x, mask=mask, deterministic=deterministic,
-                             decode=decode)
+                             decode=decode, pld_keep=pld_keep)
 
             if cfg.remat:
                 call_block = nn.remat(call_block, prevent_cse=False,
                                       policy=_remat_policy(cfg.remat_policy))
             for i in range(cfg.n_layer):
+                keep = (pld_keep_probability(i, cfg.n_layer, pld_theta)
+                        if use_pld else None)
                 x, aux_i = call_block(Block(cfg, name=f"h_{i}"), x,
-                                      attention_mask)
+                                      attention_mask, keep)
                 l_aux = l_aux + aux_i
 
         x = _norm(cfg, "ln_f")(x)

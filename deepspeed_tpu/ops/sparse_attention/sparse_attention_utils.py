@@ -71,12 +71,15 @@ def get_sparse_attention_config(param_dict: dict,
         raise NotImplementedError(
             f"sparse_attention mode '{mode}' is not supported; choose from "
             f"{sorted(_MODE_TO_CONFIG)}")
-    accepted = set(inspect.signature(cls.__init__).parameters) - {"self"}
+    # num_heads comes from the model, never from the JSON — reject it here
+    # or cls(num_heads=..., **params) dies with a confusing TypeError
+    accepted = set(inspect.signature(cls.__init__).parameters) \
+        - {"self", "num_heads"}
     unknown = set(params) - accepted
     if unknown:
         raise ValueError(
             f"sparse_attention ({mode}): unknown keys {sorted(unknown)}; "
-            f"accepted: {sorted(accepted - {'num_heads'})}")
+            f"accepted: {sorted(accepted)}")
     sc = cls(num_heads=num_heads, **params)
     if kernel_impl is not None:
         if kernel_impl not in ("gather", "pallas", "dense"):

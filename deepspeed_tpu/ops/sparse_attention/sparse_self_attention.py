@@ -649,10 +649,20 @@ class SparseSelfAttention:
                 key_padding_mask=key_padding_mask, attn_mask=attn_mask,
                 key_padding_mask_mode=self.key_padding_mask_mode,
                 attn_mask_mode=self.attn_mask_mode)
-        if self.impl == "pallas" and key_padding_mask is None \
-                and attn_mask is None:
-            return block_sparse_attention(
-                query, key, value, layout, block=block, causal=causal)
+        if self.impl == "pallas":
+            if key_padding_mask is None and attn_mask is None:
+                return block_sparse_attention(
+                    query, key, value, layout, block=block, causal=causal)
+            # the streaming kernel takes no element-level masks; an explicit
+            # pallas selection degrading to the quadratic masked-dense path
+            # must not happen silently (O(T^2) scores at long seq)
+            import warnings
+
+            warnings.warn(
+                "sparse_attention kernel='pallas' with an element mask "
+                "falls back to masked DENSE attention (full [T, T] "
+                "scores); use the default 'gather' impl for masked "
+                "inputs", stacklevel=2)
         return dense_blocksparse_attention(
             query, key, value, layout, block=block,
             causal=causal, key_padding_mask=key_padding_mask,

@@ -843,10 +843,14 @@ class DeepSpeedEngine:
         rng = jax.random.fold_in(rng, step)
         rngs = jax.random.split(rng, k)
 
+        pld_kwargs = self._pld_model_kwargs(
+            step // self.gradient_accumulation_steps)
+
         def loss_fn(p, local_batch, r):
             loss = model.apply(
                 {"params": p}, **local_batch, deterministic=False,
                 rngs={"dropout": r, "gating": jax.random.fold_in(r, 7)},
+                **pld_kwargs,
             )
             return loss * loss_scale, loss
 
@@ -929,6 +933,22 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
+    def _pld_model_kwargs(self, global_step):
+        """Extra model kwargs for stochastic-mode models under a PLD
+        schedule: ``pld_theta`` computed IN-GRAPH from the (traced) step
+        counter — theta(t) = (1 - theta)e^{-gamma t} + theta, exactly the
+        host-side ProgressiveLayerDrop schedule — so the compiled step
+        needs no per-step host transfer or recompile."""
+        if self.progressive_layer_drop is None:
+            return {}
+        if not getattr(getattr(self.module, "config", None),
+                       "stochastic_mode", False):
+            return {}
+        pc = self._config.progressive_layer_drop
+        theta = pc.theta + (1.0 - pc.theta) * jnp.exp(
+            -pc.gamma * jnp.asarray(global_step, jnp.float32))
+        return {"pld_theta": theta}
+
     def _build_fwd_bwd(self):
         if self._compressed_mode is not None:
             return self._build_fwd_bwd_compressed()
@@ -952,6 +972,7 @@ class DeepSpeedEngine:
                     {"params": p}, **batch, deterministic=False,
                     rngs={"dropout": rng,
                           "gating": jax.random.fold_in(rng, 7)},
+                    **self._pld_model_kwargs(step // gas),
                 )
                 # loss scaled by 1/gas (reference engine.py:1789 -> :1596)
                 # and by the fp16 loss scale (loss_scaler.py)
@@ -1051,6 +1072,7 @@ class DeepSpeedEngine:
                     {"params": p}, **batch, deterministic=False,
                     rngs={"dropout": rng,
                           "gating": jax.random.fold_in(rng, 7)},
+                    **self._pld_model_kwargs(step),
                 )
                 return loss * ls_state.scale, loss
 

@@ -191,14 +191,26 @@ def test_engine_trains_bigbird_from_config_alone():
         last = float(engine.train_batch(it))
     assert np.isfinite(first) and np.isfinite(last)
     assert last < first
-    # the traced program is really block-sparse: K/V blocks are gathered
-    # (default impl) and no dense [B, H, T, T] score matrix exists
+    # the traced program is really block-sparse: the gathered-score buffer
+    # [gb, H, n_light, block, W*block] exists and no dense [gb, H, T, T]
+    # score matrix does (shape strings derived, not hardcoded, so the
+    # assertion stays meaningful on any topology)
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+        _compact_index_tables, _partition_rows,
+    )
+
+    sc = engine.module.config.sparse_attention
+    layout = sc.make_layout(64)
+    light, heavy = _partition_rows(layout.sum(-1).max(0), layout.shape[-1])
+    w = _compact_index_tables(layout, light).shape[-1]
     jaxpr = str(jax.make_jaxpr(
         lambda p, b: engine.module.apply({"params": p}, **b,
                                          deterministic=True))(
         engine.params, {"input_ids": batch["input_ids"]}))
-    assert "gather" in jaxpr
-    assert "[8,2,64,64]" not in jaxpr
+    assert f"{gb},2,{len(light)},16,{w * 16}" in jaxpr, \
+        "gathered block-sparse score buffer not found in the traced program"
+    assert f"[{gb},2,64,64]" not in jaxpr, \
+        "dense [B, H, T, T] score matrix present — sparse path not taken"
 
 
 def test_engine_dense_mode_matches_unsparse_bert():
