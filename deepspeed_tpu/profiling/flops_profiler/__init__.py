@@ -8,4 +8,5 @@ from deepspeed_tpu.profiling.flops_profiler.profiler import (  # noqa: F401
     number_to_string,
     params_count,
     params_to_string,
+    profile_model_tree,
 )

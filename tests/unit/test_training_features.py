@@ -72,6 +72,62 @@ class TestFlopsProfiler:
             engine.train_batch(it)
         assert engine._flops_profiled
 
+    def test_module_tree_bert(self):
+        """Per-layer rows with the scan multiplier, summing exactly to the
+        whole-program number (reference print_model_profile tree,
+        profiler.py:235)."""
+        from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+        from deepspeed_tpu.profiling.flops_profiler import profile_model_tree
+
+        cfg = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=3,
+                         num_attention_heads=2, intermediate_size=32,
+                         max_position_embeddings=32, dtype=jnp.float32)
+        model = BertForPreTraining(cfg)
+        ids = jax.ShapeDtypeStruct((2, 32), jnp.int32)
+        rows, total = profile_model_tree(model, ids, deterministic=True,
+                                         print_profile=False)
+        by_path = {"/".join(r["path"]): r for r in rows}
+        layer = by_path["encoder/layer"]
+        assert layer["multiplier"] == 3          # scan body costed x L
+        assert by_path["encoder/layer/attention"]["multiplier"] == 3
+        # the encoder row contains its scanned layers
+        assert by_path["encoder"]["flops"] >= layer["flops"]
+        # attention dominates this tiny config
+        deepest = [r for r in rows if r["depth"] == 3]
+        assert max(deepest, key=lambda r: r["flops"])["path"][-1] == \
+            "attention"
+        # depth-1 rows + unattributed == whole-program flops EXACTLY
+        top = sum(r["flops"] for r in rows if r["depth"] == 1)
+        assert top + total["unattributed_flops"] == total["flops"]
+        assert total["params"] == sum(
+            r["params"] for r in rows if r["depth"] == 1)
+
+    def test_module_tree_gpt_scan(self):
+        from deepspeed_tpu.models.transformer_lm import GPT
+        from deepspeed_tpu.profiling.flops_profiler import profile_model_tree
+        from unit.simple_model import tiny_gpt_config
+
+        model = GPT(tiny_gpt_config(n_layer=4))
+        ids = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+        rows, total = profile_model_tree(model, ids, deterministic=True,
+                                         print_profile=False)
+        by_path = {"/".join(r["path"]): r for r in rows}
+        assert by_path["h/block"]["multiplier"] == 4
+        assert by_path["h/block/attn"]["multiplier"] == 4
+        assert total["flops"] > total["scan_body_once_flops"]
+
+    def test_get_model_profile_accepts_flax_module(self):
+        from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+        cfg = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=32,
+                         max_position_embeddings=32, dtype=jnp.float32)
+        ids = jax.ShapeDtypeStruct((2, 32), jnp.int32)
+        flops, macs, params = get_model_profile(
+            BertForPreTraining(cfg), args=(ids,),
+            kwargs={"deterministic": True}, print_profile=False)
+        assert flops > 0 and macs == flops / 2 and params > 0
+
 
 # ---------------------------------------------------------------------------
 # curriculum learning
