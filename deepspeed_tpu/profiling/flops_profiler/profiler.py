@@ -242,15 +242,23 @@ def profile_model_tree(model, *args, variables=None, depth: int = 3,
     # this function's own options (a model whose __call__ takes `depth`
     # would otherwise silently lose it to the tree-depth cutoff)
     kwargs = {**(model_kwargs or {}), **kwargs}
+    # split static leaves (python bools like a positional `deterministic`)
+    # out of the top-level args — tracing them as device scalars would
+    # break the model's python control flow, same as for submodules
+    arg_avals, top_rebuild = _split_static(args)
+
     if variables is None:
         # eval_shape takes ShapeDtypeStructs directly — no concrete zeros
         variables = jax.eval_shape(
-            model.init, jax.random.PRNGKey(0), *args, **kwargs)
+            lambda arrs: model.init(jax.random.PRNGKey(0),
+                                    *top_rebuild(arrs), **kwargs),
+            arg_avals)
     var_avals = _avalize(variables)
-    arg_avals = _avalize(args)
 
-    whole = cost_analysis(
-        lambda v, a: model.apply(v, *a, **kwargs), var_avals, arg_avals)
+    def _apply(v, arrs):
+        return model.apply(v, *top_rebuild(arrs), **kwargs)
+
+    whole = cost_analysis(_apply, var_avals, arg_avals)
     whole["params"] = params_count(
         variables.get("params", variables))
 
@@ -286,8 +294,10 @@ def profile_model_tree(model, *args, variables=None, depth: int = 3,
             active.pop()
 
     with nn.intercept_methods(interceptor):
-        jax.eval_shape(lambda v, a: model.apply(v, *a, **kwargs),
-                       var_avals, arg_avals)
+        # FRESH lambda on purpose: jax caches traces by function identity,
+        # and a cache hit from the cost_analysis above would skip tracing
+        # entirely — the interceptor would never fire
+        jax.eval_shape(lambda v, a: _apply(v, a), var_avals, arg_avals)
 
     rows = []
     for path in order:
@@ -311,6 +321,7 @@ def profile_model_tree(model, *args, variables=None, depth: int = 3,
             "path": path, "name": r["name"], "depth": len(path),
             "multiplier": mult,
             "std_flops": cost["flops"],
+            "std_bytes": cost["bytes_accessed"],
             "flops": cost["flops"] * mult,
             "bytes_accessed": cost["bytes_accessed"] * mult,
             "params": p_local * mult,
@@ -342,24 +353,29 @@ def profile_model_tree(model, *args, variables=None, depth: int = 3,
         mult_of[r["path"]] = r["multiplier"]
 
     total_flops = whole["flops"]
+    total_bytes = whole["bytes_accessed"]
     for r in rows:
         pm = parent_mult(r["path"])
         if r["multiplier"] > pm:    # scan-body root
             extra = r["std_flops"] * (r["multiplier"] - pm)
+            extra_bytes = r["std_bytes"] * (r["multiplier"] - pm)
             total_flops += extra
+            total_bytes += extra_bytes
             for a in rows:
                 if (len(a["path"]) < len(r["path"])
                         and r["path"][:len(a["path"])] == a["path"]):
                     a["flops"] += extra
+                    a["bytes_accessed"] += extra_bytes
     for r in rows:
         r["macs"] = r["flops"] / 2
         r["share"] = r["flops"] / total_flops if total_flops else 0.0
-        del r["std_flops"]
+        del r["std_flops"], r["std_bytes"]
 
     top_level = [r for r in rows if r["depth"] == 1]
     attributed = sum(r["flops"] for r in top_level)
     unattributed = total_flops - attributed
     total = dict(whole, flops=total_flops, macs=total_flops / 2,
+                 bytes_accessed=total_bytes,
                  scan_body_once_flops=whole["flops"],
                  unattributed_flops=unattributed)
 
@@ -368,13 +384,14 @@ def profile_model_tree(model, *args, variables=None, depth: int = 3,
         # (XLA fuses across module boundaries, so per-module timers do not
         # exist post-compilation; the reference's hook latencies have the
         # mirror-image caveat — they measure eager, unfused execution)
+        concrete_arrs = [l for l in jax.tree.leaves(args)
+                         if _is_array_leaf(l)]
         all_concrete = not any(
             isinstance(l, jax.ShapeDtypeStruct)
-            for l in jax.tree.leaves((variables, args)))
+            for l in jax.tree.leaves((variables, concrete_arrs)))
         if all_concrete:
             latency = measure_latency(
-                jax.jit(lambda v, a: model.apply(v, *a, **kwargs)),
-                variables, args)
+                jax.jit(_apply), variables, concrete_arrs)
             total["latency_s"] = latency
             for r in rows:
                 r["est_latency_s"] = latency * r["share"]

@@ -476,6 +476,14 @@ class PipelineEngine:
                         save_latest=True):
         assert self._initialized
         tag = tag or f"global_step{self.global_steps}"
+        # purge any previous save under this tag: filenames are keyed by
+        # layer bounds, so a re-save at a DIFFERENT pipeline degree would
+        # otherwise leave stale files that a merging load could pick up
+        import glob as _glob
+
+        for stale in _glob.glob(os.path.join(
+                save_dir, str(tag), "layer_bounds_*_model_states.msgpack")):
+            os.remove(stale)
         for s in range(self.num_stages):
             self.checkpoint_engine.save(
                 {"module": serialization.to_state_dict(self._params[s])},
@@ -490,19 +498,47 @@ class PipelineEngine:
         return True
 
     def load_checkpoint(self, load_dir, tag=None, **_):
+        """Reload stage params; the checkpoint's pipeline degree need not
+        match this engine's. Layers are stored under GLOBAL names
+        (``layer_N``) in per-stage files keyed by their layer bounds, so a
+        degree change just merges every file and re-splits by the current
+        bounds (reference ``checkpoint/reshape_3d_utils.py`` reshapes the
+        same way, offline; here the load does it in place)."""
+        import glob as _glob
+
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as f:
                 tag = f.read().strip()
         assert self._initialized, "run one batch (or init) before load"
+        exact = [os.path.join(
+            load_dir, str(tag),
+            f"layer_bounds_{self.stage_bounds[s]}_"
+            f"{self.stage_bounds[s + 1]}_model_states.msgpack")
+            for s in range(self.num_stages)]
+        if all(os.path.exists(f) for f in exact):
+            files = exact        # same degree: read only our own files
+        else:
+            files = sorted(_glob.glob(os.path.join(
+                load_dir, str(tag), "layer_bounds_*_model_states.msgpack")))
+        if not files:
+            raise FileNotFoundError(
+                f"no layer_bounds_*_model_states.msgpack under "
+                f"{load_dir}/{tag}")
+        merged = {}
+        for f in files:
+            merged.update(self.checkpoint_engine.load(f)["module"])
         for s in range(self.num_stages):
-            state = self.checkpoint_engine.load(
-                os.path.join(load_dir, str(tag),
-                             f"layer_bounds_{self.stage_bounds[s]}_"
-                             f"{self.stage_bounds[s+1]}_model_states.msgpack"))
+            want = set(self._params[s])
+            missing = want - set(merged)
+            if missing:
+                raise KeyError(
+                    f"checkpoint {tag} lacks layers {sorted(missing)} for "
+                    f"stage {s} (saved layers: {sorted(merged)})")
             restored = serialization.from_state_dict(
-                self._params[s], state["module"])
+                self._params[s], {k: merged[k] for k in self._params[s]})
             self._params[s] = jax.jit(
                 lambda t: t, out_shardings=self._param_shardings[s])(restored)
+        self._sync_tied_params()
         return tag, {}
 
     @property
