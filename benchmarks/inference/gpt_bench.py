@@ -9,6 +9,11 @@ Measured r3 (gpt2-125m bf16, 128-token prompt, 64 new tokens, one v5e over
 the dev tunnel, scan-decode chunk 32): batch 1 — 2.8 ms/token p50, 353
 tokens/sec; batch 8 — 3.34 ms/step, 2392 tokens/sec; batch 32 — 6.92
 ms/step, 4623 tokens/sec.
+
+r4, --dtype int8 (weight-only; per-layer in-scan dequant, see
+int8_results.json): gpt2-1.3b per-token p50 5.55 -> 4.05 ms at batch 1
+(1.37x), 7.78 -> 6.38 at batch 8, 15.09 -> 13.85 at batch 32; logit MSE
+5.8e-4 of bf16 logit variance. 125M stays dispatch-bound (int8 ~ even).
 """
 
 import argparse
@@ -25,7 +30,10 @@ def main():
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=64)
     p.add_argument("--trials", type=int, default=5)
-    p.add_argument("--dtype", default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--dtype", default="bf16",
+                   choices=["bf16", "fp32", "int8"])
+    p.add_argument("--quality", action="store_true",
+                   help="also report logit MSE vs a bf16 engine")
     args = p.parse_args()
 
     import jax
@@ -35,12 +43,14 @@ def main():
     import deepspeed_tpu
     from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config
 
+    # int8 = weight-only quantization over a bf16 compute graph
     cfg = gpt2_config(
         args.model,
-        dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+        dtype=jnp.float32 if args.dtype == "fp32" else jnp.bfloat16,
         n_positions=args.prompt_len + args.tokens)
     engine = deepspeed_tpu.init_inference(
-        GPT(cfg), dtype=cfg.dtype, replace_with_kernel_inject=True)
+        GPT(cfg), dtype=args.dtype, replace_with_kernel_inject=True,
+        seed=0)
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(
@@ -72,6 +82,19 @@ def main():
           f"p90={np.percentile(per_tok, 90):.2f} ms")
     print(f"throughput  {args.batch * args.tokens / np.median(e2e):.1f} "
           f"tokens/sec")
+
+    if args.quality and args.dtype == "int8":
+        # logit MSE vs the bf16 engine on the same prompt (reference
+        # reports the analogous accuracy deltas for its int8 kernels)
+        ref = deepspeed_tpu.init_inference(
+            GPT(cfg), dtype="bf16", replace_with_kernel_inject=True,
+            seed=0)
+        lq = np.asarray(engine.forward(ids), dtype=np.float32)
+        lr = np.asarray(ref.forward(ids), dtype=np.float32)
+        mse = float(np.mean((lq - lr) ** 2))
+        rel = mse / float(np.var(lr))
+        print(f"quality     logit MSE={mse:.5f} "
+              f"(relative to bf16 logit variance: {rel:.5f})")
 
 
 if __name__ == "__main__":

@@ -98,6 +98,17 @@ class GPTConfig:
     # An int >= 1 forces it with that token chunk size (default 2048);
     # 0/False disable.
     fused_head_ce: Any = "auto"
+    # weight-only int8 serving (reference int8 GEMM inference kernels,
+    # csrc/transformer/inference/csrc/pt_binding.cpp:1535): block matmul
+    # kernels are STORED as {"q": int8, "scale": f32[out]} and dequantized
+    # per layer INSIDE the scan body (nn.map_variables), where XLA fuses
+    # the convert into the consuming dots — per-token HBM weight traffic
+    # stays int8. Dequantizing the whole stacked [L, ...] tree outside the
+    # layer scan instead materializes a full bf16 copy per decode step
+    # (measured 2x SLOWER than bf16 at 1.3B). Inference-only flag, set by
+    # init_inference(dtype="int8"); tp sharding specs do not apply to the
+    # quantized layout yet.
+    quantized_weights: bool = False
     # stochastic transformer (reference op_builder/stochastic_transformer.py,
     # ops/transformer/transformer.py:110 stochastic_mode): whole-block
     # stochastic depth. When training under a progressive-layer-drop
@@ -579,6 +590,76 @@ def alibi_slopes(n_head: int) -> np.ndarray:
     return np.asarray(slopes, np.float32)
 
 
+def quantize_block_params(tree):
+    """2-D ``kernel`` leaves -> {"q": int8, "scale": f32[out]} (symmetric
+    per-output-column). The storage format of ``quantized_weights``; also
+    the ``trans_out_fn`` that makes ``model.init`` produce this structure
+    natively so shape/sharding trees stay consistent."""
+    from collections.abc import Mapping
+
+    from deepspeed_tpu.ops.quantizer import quantize_weight_per_column
+
+    def walk(t):
+        if isinstance(t, Mapping):   # plain dict OR flax FrozenDict
+            out = {}
+            for k, v in t.items():
+                if (k == "kernel" and hasattr(v, "ndim")
+                        and v.ndim in (2, 3)
+                        and jnp.issubdtype(v.dtype, jnp.floating)):
+                    if v.ndim == 2:
+                        q, s = quantize_weight_per_column(v, num_bits=8)
+                    else:  # scan-stacked [n_layer, in, out]
+                        q, s = jax.vmap(lambda w: quantize_weight_per_column(
+                            w, num_bits=8))(v)
+                    out[k] = {"q": q, "scale": s}
+                else:
+                    out[k] = walk(v)
+            return out
+        return t
+
+    return walk(tree)
+
+
+def dequantize_block_params(tree, dtype):
+    """Trace-level inverse of :func:`quantize_block_params`: runs INSIDE
+    the layer scan on one layer's slice, so the int8->compute convert
+    fuses into that layer's matmuls."""
+
+    from collections.abc import Mapping
+
+    def walk(t):
+        if isinstance(t, Mapping):   # plain dict OR flax FrozenDict
+            if set(t) == {"q", "scale"}:
+                return t["q"].astype(dtype) * t["scale"][None, :].astype(
+                    dtype)
+            return {k: walk(v) for k, v in t.items()}
+        return t
+
+    return walk(tree)
+
+
+def _maybe_quantized_block(block_cls, cfg):
+    """Wrap a block class so its params live int8-at-rest (see
+    GPTConfig.quantized_weights).
+
+    init=False on purpose: with init=True, flax's map_variables runs the
+    wrapped function ONCE with the raw (still-quantized) params whenever
+    any other collection is mutable — i.e. on every KV-cache-creating
+    decode apply — and Dense then chokes on the {q, scale} dict. The
+    trade-off is that ``model.init`` cannot create params through the
+    transform: initialize a dense twin (quantized_weights=False) and
+    convert with :func:`quantize_block_params`, which is what
+    ``InferenceEngine._materialize`` does."""
+    if not cfg.quantized_weights:
+        return block_cls
+    import functools
+
+    return nn.map_variables(
+        block_cls, "params",
+        trans_in_fn=functools.partial(dequantize_block_params,
+                                      dtype=cfg.dtype))
+
+
 def pld_keep_probability(layer_idx, n_layer: int, theta):
     """Depth schedule for PLD stochastic depth: layer i survives with
     ``p_i = 1 - (i/L)(1 - theta)`` — deeper layers drop more. Shared by
@@ -638,7 +719,7 @@ class ScannedBlocks(nn.Module):
             x, l_aux = call_block(block, x, mask, layer_idx)
             return (x, mask), l_aux
 
-        block_cls = Block
+        block_cls = _maybe_quantized_block(Block, cfg)
         if cfg.param_offload:
             # ZeRO-Infinity param tier: the scan's per-iteration slice of
             # the (host-resident) layer stack is copied into HBM right
@@ -648,8 +729,8 @@ class ScannedBlocks(nn.Module):
             from deepspeed_tpu.ops.streaming import stream_tree_to_device
 
             block_cls = nn.map_variables(
-                Block, "params", trans_in_fn=stream_tree_to_device,
-                init=True)
+                block_cls, "params", trans_in_fn=stream_tree_to_device,
+                init=True)  # composes: stream int8-at-rest, dequant inner
 
         scanned = nn.scan(
             body,
@@ -763,10 +844,11 @@ class GPT(nn.Module):
             if cfg.remat:
                 call_block = nn.remat(call_block, prevent_cse=False,
                                       policy=_remat_policy(cfg.remat_policy))
+            loop_block_cls = _maybe_quantized_block(Block, cfg)
             for i in range(cfg.n_layer):
                 keep = (pld_keep_probability(i, cfg.n_layer, pld_theta)
                         if use_pld else None)
-                x, aux_i = call_block(Block(cfg, name=f"h_{i}"), x,
+                x, aux_i = call_block(loop_block_cls(cfg, name=f"h_{i}"), x,
                                       attention_mask, keep)
                 l_aux = l_aux + aux_i
 

@@ -112,6 +112,26 @@ def quantize_weight_per_column(w: jnp.ndarray, num_bits: int = 8
     return q, scale
 
 
+def quantize_weight_per_column_np(w, num_bits: int = 8):
+    """HOST-side (numpy) twin of :func:`quantize_weight_per_column` —
+    same scale/clip math, kept adjacent so the formulas cannot drift.
+    Used when quantizing imported weights before device placement (an
+    on-device quantize would land the full-precision leaf on one chip
+    first). Also accepts a scan-stacked [L, in, out] weight (per-layer
+    per-column scales, shape [L, out])."""
+    import numpy as np
+
+    w = np.asarray(w, np.float32)
+    assert w.ndim in (2, 3), "expected [in, out] or [L, in, out]"
+    qmax = float(2 ** (num_bits - 1) - 1)
+    axis = 0 if w.ndim == 2 else 1
+    scale = np.maximum(np.abs(w).max(axis=axis) / qmax, 1e-12)
+    sb = scale[None, :] if w.ndim == 2 else scale[:, None, :]
+    q = np.clip(np.round(w / sb), -qmax - 1, qmax)
+    return (q.astype(np.int8 if num_bits <= 8 else np.int32),
+            scale.astype(np.float32))
+
+
 def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
                 preferred_dtype=jnp.bfloat16) -> jnp.ndarray:
     """Matmul against a per-output-column int8 weight (inference int8 path,

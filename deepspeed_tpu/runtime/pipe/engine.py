@@ -476,20 +476,27 @@ class PipelineEngine:
                         save_latest=True):
         assert self._initialized
         tag = tag or f"global_step{self.global_steps}"
-        # purge any previous save under this tag: filenames are keyed by
-        # layer bounds, so a re-save at a DIFFERENT pipeline degree would
-        # otherwise leave stale files that a merging load could pick up
         import glob as _glob
 
-        for stale in _glob.glob(os.path.join(
-                save_dir, str(tag), "layer_bounds_*_model_states.msgpack")):
-            os.remove(stale)
+        pre_existing = set(_glob.glob(os.path.join(
+            save_dir, str(tag), "layer_bounds_*_model_states.msgpack")))
+        written = set()
         for s in range(self.num_stages):
+            path = os.path.join(
+                save_dir, str(tag),
+                f"layer_bounds_{self.stage_bounds[s]}_"
+                f"{self.stage_bounds[s+1]}_model_states.msgpack")
             self.checkpoint_engine.save(
                 {"module": serialization.to_state_dict(self._params[s])},
-                os.path.join(save_dir, str(tag),
-                             f"layer_bounds_{self.stage_bounds[s]}_"
-                             f"{self.stage_bounds[s+1]}_model_states.msgpack"))
+                path)
+            written.add(path)
+        # purge stale files from an earlier save at a DIFFERENT pipeline
+        # degree (their bounds-keyed names differ, and a merging load
+        # could pick them up) — but only AFTER every new stage file
+        # landed, so a mid-save crash still leaves the previous complete
+        # set on disk
+        for stale in sorted(pre_existing - written):
+            os.remove(stale)
         # durability barrier BEFORE advertising 'latest' (async engine)
         self.checkpoint_engine.commit(tag)
         if save_latest:
