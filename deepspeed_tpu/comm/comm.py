@@ -207,7 +207,9 @@ def init_distributed(
                 probe = socket.socket(family, socket.SOCK_DGRAM)
                 try:
                     probe.connect((host, 9))
-                    addr = f"{probe.getsockname()[0]}:0"
+                    ip = probe.getsockname()[0]
+                    # bracket IPv6 or the host:port split is ambiguous
+                    addr = f"[{ip}]:0" if ":" in ip else f"{ip}:0"
                 finally:
                     probe.close()
             except OSError:

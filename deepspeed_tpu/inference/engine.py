@@ -44,6 +44,14 @@ def _conform_host_quantized(host, shapes):
         q, scale = quantize_weight_per_column_np(host, num_bits=8)
         return {"q": q, "scale": scale}
     if isinstance(shapes, dict):
+        if set(host) != set(shapes):
+            # keep the loud structure-mismatch the dense placement path
+            # raises — silently dropping misnamed imported leaves would
+            # serve a half-loaded model
+            raise ValueError(
+                f"imported params do not match the model: extra "
+                f"{sorted(set(host) - set(shapes))}, missing "
+                f"{sorted(set(shapes) - set(host))}")
         return {k: _conform_host_quantized(host[k], v)
                 for k, v in shapes.items()}
     return host
@@ -158,60 +166,32 @@ class InferenceEngine:
                 # the model stores its own {q, scale} layout (init/
                 # conform already produced it) — nothing to do here
                 return params
-            # TRUE weight-only int8 (reference GroupQuantizer + int8 GEMM
-            # path, replace_module.py:139, pt_binding.cpp:1535): matmul
-            # kernels are STORED as int8 + per-output-column scales and
-            # dequantized inside the compiled step, at the apply call
-            # sites — inside the decode scan body, where XLA fuses the
-            # convert into the dot, so per-token HBM weight reads are int8
-            # (measured 27% faster than bf16 matvecs on a v5e; see
-            # benchmarks/inference/int8_results.json). Embeddings, norms,
-            # and biases stay in compute dtype.
-            from deepspeed_tpu.ops.quantizer import quantize_weight_per_column
-            from deepspeed_tpu.utils.tree import path_str
+            # engine-level fallback for models WITHOUT the config flag:
+            # same self-describing {q, scale} storage (reference
+            # GroupQuantizer + int8 GEMM path, replace_module.py:139,
+            # pt_binding.cpp:1535), dequantized in _dequant at the apply
+            # call sites. Caveat vs the model-level path: for scanned
+            # models the dequant sits OUTSIDE the layer scan, so the
+            # stacked bf16 copy materializes per step — functional, not
+            # the bandwidth win (int8_results.json measures both).
+            from deepspeed_tpu.models.transformer_lm import \
+                quantize_block_params
 
-            flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-            scales, dtypes, leaves = {}, {}, []
-            for p, x in flat:
-                ps = path_str(p)
-                if (ps.endswith("kernel") and x.ndim in (2, 3)
-                        and jnp.issubdtype(x.dtype, jnp.floating)):
-                    if x.ndim == 2:
-                        q, s = quantize_weight_per_column(x, num_bits=8)
-                    else:  # scan-stacked layers: (n_layer, in, out)
-                        q, s = jax.vmap(
-                            lambda w: quantize_weight_per_column(
-                                w, num_bits=8))(x)
-                    scales[ps] = s
-                    dtypes[ps] = x.dtype
-                    leaves.append(q.astype(jnp.int8))
-                else:
-                    leaves.append(x)
-            self._quant_scales = scales
-            self._quant_dtypes = dtypes
-            return jax.tree_util.tree_unflatten(treedef, leaves)
+            self._engine_quantized = True
+            return quantize_block_params(params)
         return params
 
     def _dequant(self, params):
-        """Trace-level inverse of the int8 cast: rebuild compute-dtype
-        kernels from int8 + scales. Call at the model.apply site (inside
-        scan bodies) so the convert fuses into the consuming matmul."""
-        if not getattr(self, "_quant_scales", None):
+        """Trace-level inverse of the engine-level int8 cast (identity for
+        model-level quantized_weights, where the layer scan dequantizes)."""
+        if not getattr(self, "_engine_quantized", False):
             return params
-        from deepspeed_tpu.utils.tree import path_str
+        from deepspeed_tpu.models.transformer_lm import \
+            dequantize_block_params
 
-        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-        out = []
-        for p, x in flat:
-            ps = path_str(p)
-            s = self._quant_scales.get(ps)
-            if s is None:
-                out.append(x)
-                continue
-            dt = self._quant_dtypes[ps]
-            sb = s[:, None, :] if x.ndim == 3 else s[None, :]
-            out.append((x.astype(dt) * sb.astype(dt)))
-        return jax.tree_util.tree_unflatten(treedef, out)
+        compute = getattr(getattr(self.module, "config", None), "dtype",
+                          None) or jnp.bfloat16
+        return dequantize_block_params(params, compute)
 
     def _materialize(self, input_ids):
         model = self.module
@@ -452,6 +432,16 @@ class InferenceEngine:
         # ms/token p50): scan length 1: 5.7, 8: 3.7, 16: 2.6, 32: 2.4,
         # 63: 3.4 — 16-32 is the plateau, so chunk defaults to 32.
         chunk = max(1, int(self._config.get("decode_chunk", 32)))
+        eff = 1 << (chunk.bit_length() - 1)
+        if eff != chunk:
+            from deepspeed_tpu.utils.logging import warning_once
+
+            # each dispatch runs the largest power-of-two scan <= chunk
+            # (binary tail decomposition bounds the compile cache); say so
+            # once instead of silently flooring a configured 24 to 16
+            warning_once(
+                f"decode_chunk={chunk} is not a power of two; dispatches "
+                f"use {eff}-token scans (plus a binary-decomposed tail)")
         remaining = max_new_tokens - 1
         while remaining > 0:
             k = min(chunk, remaining)

@@ -630,8 +630,9 @@ def dequantize_block_params(tree, dtype):
     def walk(t):
         if isinstance(t, Mapping):   # plain dict OR flax FrozenDict
             if set(t) == {"q", "scale"}:
-                return t["q"].astype(dtype) * t["scale"][None, :].astype(
-                    dtype)
+                q, s = t["q"], t["scale"]
+                sb = s[:, None, :] if q.ndim == 3 else s[None, :]
+                return q.astype(dtype) * sb.astype(dtype)
             return {k: walk(v) for k, v in t.items()}
         return t
 
@@ -881,6 +882,12 @@ class GPT(nn.Module):
         # (a [b, t-1, V] slice forces padded-tile reductions and a copy)
         fused = cfg.fused_head_ce
         if fused == "auto":
+            # NOTE: B*T here is whatever the model was TRACED with — the
+            # global batch under plain pjit, but the per-shard batch when
+            # applied inside a shard_map/pipeline stage. Losses match
+            # either way; only the 4 GB engage point is topology-dependent
+            # (per-device logits are 1/dp of this under pjit). Force
+            # fused_head_ce=True/int to pin the behavior across topologies.
             logits_bytes = (B * T * cfg.vocab_size
                             * jnp.dtype(cfg.dtype).itemsize)
             fused = logits_bytes >= (4 << 30)

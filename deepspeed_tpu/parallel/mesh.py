@@ -114,13 +114,24 @@ class MeshTopology:
         job (device.slice_index varies) build a hybrid ICI x DCN mesh where
         the slice dimension is absorbed by the outermost parallel axes —
         the 'collectives ride ICI, not DCN' layout. Plain reshape off-TPU."""
+        is_tpu = bool(devices) and getattr(
+            devices[0], "platform", "cpu") == "tpu"
+        slice_ids = ({getattr(d, "slice_index", None) or 0 for d in devices}
+                     if is_tpu else set())
         try:
             from jax.experimental import mesh_utils
         except Exception:
+            if len(slice_ids) > 1:
+                # the plain-reshape fallback is exactly the silent
+                # DCN-crossing layout the multi-slice branch exists to
+                # reject — fail loudly instead
+                raise RuntimeError(
+                    "multi-slice TPU job but jax.experimental.mesh_utils "
+                    "is unavailable: cannot build the hybrid ICI x DCN "
+                    "mesh; a plain reshape would route tp/sp collectives "
+                    "over DCN")
             return np.array(devices).reshape(shape)
-        if devices and getattr(devices[0], "platform", "cpu") == "tpu":
-            slice_ids = {getattr(d, "slice_index", None) or 0
-                         for d in devices}
+        if is_tpu:
             if len(slice_ids) > 1:
                 # multi-slice must not silently fall back: a plain reshape
                 # would route tp/sp collectives over DCN

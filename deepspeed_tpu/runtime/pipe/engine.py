@@ -490,15 +490,15 @@ class PipelineEngine:
                 {"module": serialization.to_state_dict(self._params[s])},
                 path)
             written.add(path)
-        # purge stale files from an earlier save at a DIFFERENT pipeline
-        # degree (their bounds-keyed names differ, and a merging load
-        # could pick them up) — but only AFTER every new stage file
-        # landed, so a mid-save crash still leaves the previous complete
-        # set on disk
+        # durability barrier BEFORE advertising 'latest' (async engine:
+        # save() only enqueues; files land at commit)
+        self.checkpoint_engine.commit(tag)
+        # only now purge stale files from an earlier save at a DIFFERENT
+        # pipeline degree (their bounds-keyed names differ, and a merging
+        # load could pick them up): a crash any earlier leaves the
+        # previous complete set on disk
         for stale in sorted(pre_existing - written):
             os.remove(stale)
-        # durability barrier BEFORE advertising 'latest' (async engine)
-        self.checkpoint_engine.commit(tag)
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
