@@ -64,17 +64,24 @@ def main():
     gc.collect()
 
     g = gpt_pretrain.run()
+    mfu = g["model_tflops"] / PEAK_BF16_TFLOPS
     print(json.dumps({
         "metric": "gpt2_1.3b_seq1024_train_tflops_per_chip",
         "value": g["model_tflops"],
         "unit": "TFLOPS",
-        "mfu": round(g["model_tflops"] / PEAK_BF16_TFLOPS, 3),
+        "mfu": round(mfu, 3),
         "mfu_reference_a100_fleet": 0.50,  # 157/312 published A100 MFU
-        "vs_baseline": round(
+        # the honest headline ratio: matched-scale MFU vs the reference's
+        # published A100-fleet utilization. The only single-DEVICE 1.3B
+        # number the reference publishes is a ZeRO-Offload config (30
+        # TFLOPS, docs/_pages/training.md:293) — beating an offload config
+        # from HBM is not a like-for-like win, so that ratio is reported
+        # under its own name below, not as vs_baseline.
+        "vs_baseline": round(mfu / 0.50, 3),
+        "vs_baseline_metric": "MFU vs the reference A100 fleet's ~50% MFU "
+                              "at the same scale (157/312 published)",
+        "vs_v100_zero_offload_30tflops": round(
             g["model_tflops"] / gpt_pretrain.BASELINE_TFLOPS, 3),
-        "vs_baseline_metric": "ZeRO-Offload single-V100 30 TFLOPS "
-                              "(docs/_pages/training.md:293) — an OFFLOAD "
-                              "config; the honest comparison is MFU",
         "samples_per_sec": g["samples_per_sec"],
         "ms_per_step": g["ms_per_step"],
         "seq_len": g["seq"],

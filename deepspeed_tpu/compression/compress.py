@@ -35,9 +35,49 @@ class Compressor:
         self.groups, self.layer_reduction = \
             parse_compression_config(ds_config)
         self.scheduler = CompressionScheduler(self.groups)
+        self._jit_cache: Dict[Any, Any] = {}
 
     def enabled(self) -> bool:
         return bool(self.groups)
+
+    def signature(self, step: int):
+        """Hashable schedule state at ``step``: which groups are active and
+        at what bits. ``apply`` is a pure function of (params, signature),
+        which makes it jit-cacheable per signature (bits anneal through a
+        handful of values, so the cache stays tiny)."""
+        sig = []
+        for g in self.groups:
+            active = self.scheduler.is_active(g, step)
+            if g.technique == "activation_quantization":
+                # applied in-forward, not on the param tree: a param-tree
+                # apply for it would be an identity pass — never count it
+                # toward triggering one
+                active = False
+            bits = (self.scheduler.current_bits(g, step)
+                    if active and g.technique == "weight_quantization"
+                    else None)
+            sig.append((active, bits))
+        return tuple(sig)
+
+    def jitted_apply(self, params, step: int,
+                     key: Optional[jax.Array] = None):
+        """`apply`, compiled once per schedule signature — the per-step
+        engine hook (the MoQ pattern: project params onto the compressed
+        set at step boundaries, one fused device program instead of an
+        eager op per leaf)."""
+        if not self.groups:
+            return params
+        sig = self.signature(step)
+        if not any(active for active, _ in sig):
+            return params
+        fn = self._jit_cache.get(sig)
+        if fn is None:
+            # bind the concrete step via closure: inside, the python
+            # scheduler logic sees a concrete int and traces one branch
+            fn = jax.jit(
+                lambda p, k, _step=step: self.apply(p, _step, key=k))
+            self._jit_cache[sig] = fn
+        return fn(params, key)
 
     # ------------------------------------------------------------------
     def apply(self, params, step: int,

@@ -44,6 +44,10 @@ def _conform_host_quantized(host, shapes):
         q, scale = quantize_weight_per_column_np(host, num_bits=8)
         return {"q": q, "scale": scale}
     if isinstance(shapes, dict):
+        if not isinstance(host, dict):
+            raise ValueError(
+                f"imported params have a leaf where the model expects a "
+                f"submodule with keys {sorted(shapes)}")
         if set(host) != set(shapes):
             # keep the loud structure-mismatch the dense placement path
             # raises — silently dropping misnamed imported leaves would

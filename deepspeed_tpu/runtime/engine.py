@@ -410,6 +410,20 @@ class DeepSpeedEngine:
             from deepspeed_tpu.runtime.quantize import Quantizer
             self.quantizer = Quantizer.from_config(config.quantize_training)
 
+        # compression-aware training from the compression_training block
+        # (reference compression/compress.py init_compression, which users
+        # call on the model; here the engine consumes the config directly
+        # and projects params onto the compressed set at step boundaries —
+        # the same step-boundary pattern as MoQ below)
+        self.compression_compressor = None
+        if config.compression_training:
+            from deepspeed_tpu.compression import init_compression
+
+            comp = init_compression(
+                {"compression_training": config.compression_training})
+            if comp.enabled():
+                self.compression_compressor = comp
+
         # compiled fns (built on first use)
         self._flops_profiled = False
         self._reshard_params_fn = None
@@ -1359,6 +1373,16 @@ class DeepSpeedEngine:
                 self._reshard_params_fn = jax.jit(
                     lambda t: t, out_shardings=self._param_shardings)
             self._params = self._reshard_params_fn(quantized)
+        if self.compression_compressor is not None and not (
+                self.fp16_enabled and bool(overflow)):
+            self._rng, crng = jax.random.split(self._rng)
+            compressed = self.compression_compressor.jitted_apply(
+                self._params, self.global_steps, key=crng)
+            if compressed is not self._params:
+                if self._reshard_params_fn is None:
+                    self._reshard_params_fn = jax.jit(
+                        lambda t: t, out_shardings=self._param_shardings)
+                self._params = self._reshard_params_fn(compressed)
         if self.global_steps % self._config.steps_per_print == 0:
             self._report_progress()
         # gate on enabled BEFORE the float() conversions: pulling the loss

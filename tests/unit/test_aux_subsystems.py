@@ -452,3 +452,102 @@ class TestCompressedLayerLibrary:
         np.testing.assert_allclose(np.asarray(y_col),
                                    np.asarray(y_serial), atol=0.1,
                                    rtol=0.2)
+
+
+class TestCompressionEngineWiring:
+    """compression_training consumed by the ENGINE: the config block alone
+    compresses a training run (reference users call init_compression on
+    the model; here the step-boundary projection is engine-automatic, the
+    MoQ pattern)."""
+
+    def test_config_block_compresses_training(self, eight_devices):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer_lm import GPT
+        from unit.simple_model import tiny_gpt_config
+
+        ds = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9,
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {
+                        "enabled": True, "quantization_type": "symmetric",
+                        "rounding": "nearest", "quantize_groups": 1,
+                        "schedule_offset": 0},
+                    "different_groups": {
+                        "wq": {"params": {"start_bits": 8, "target_bits": 4,
+                                          "quantization_period": 2},
+                               "modules": ["c_fc"]}},
+                },
+                "sparse_pruning": {
+                    "shared_parameters": {"enabled": True, "method": "l1",
+                                          "schedule_offset": 0},
+                    "different_groups": {
+                        "sp": {"params": {"dense_ratio": 0.5},
+                               "modules": ["c_proj"]}},
+                },
+            },
+        }
+        model = GPT(tiny_gpt_config())
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds)
+        assert engine.compression_compressor is not None
+        gb = engine.train_micro_batch_size_per_gpu * \
+            engine.topology.data_parallel_size
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, size=(gb, 16)).astype(np.int32)
+        it = iter([{"input_ids": ids, "labels": ids}] * 12)
+        losses = [float(engine.train_batch(it)) for _ in range(10)]
+        assert all(np.isfinite(l) for l in losses)
+
+        from deepspeed_tpu.utils.tree import flatten_dots
+        flat = flatten_dots(jax.device_get(engine.params))
+        fc = [v for k, v in flat.items() if "c_fc" in k and k.endswith("kernel")]
+        pr = [v for k, v in flat.items() if "c_proj" in k and k.endswith("kernel")]
+        assert fc and pr
+        for w in fc:
+            # bits annealed 8 -> 4 by step 10: at most 2^4 - 1 levels per
+            # group (symmetric) — allow the full 16 for rounding edge
+            assert len(np.unique(np.asarray(w))) <= 16, \
+                f"{len(np.unique(np.asarray(w)))} levels"
+        for w in pr:
+            zeros = float((np.asarray(w) == 0).mean())
+            assert zeros >= 0.45, f"only {zeros:.2f} of c_proj zeroed"
+
+    def test_compression_schedule_offset_delays(self, eight_devices):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer_lm import GPT
+        from unit.simple_model import tiny_gpt_config
+
+        ds = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9,
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {
+                        "enabled": True, "quantization_type": "symmetric",
+                        "rounding": "nearest", "quantize_groups": 1,
+                        "schedule_offset": 1000},
+                    "different_groups": {
+                        "wq": {"params": {"start_bits": 8, "target_bits": 4,
+                                          "quantization_period": 10},
+                               "modules": ["c_fc"]}},
+                },
+            },
+        }
+        model = GPT(tiny_gpt_config())
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds)
+        gb = engine.train_micro_batch_size_per_gpu * \
+            engine.topology.data_parallel_size
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, size=(gb, 16)).astype(np.int32)
+        it = iter([{"input_ids": ids, "labels": ids}] * 3)
+        for _ in range(2):
+            engine.train_batch(it)
+        from deepspeed_tpu.utils.tree import flatten_dots
+        flat = flatten_dots(jax.device_get(engine.params))
+        fc = [v for k, v in flat.items()
+              if "c_fc" in k and k.endswith("kernel")]
+        # offset 1000 not reached: weights still full precision
+        assert all(len(np.unique(np.asarray(w))) > 256 for w in fc)
