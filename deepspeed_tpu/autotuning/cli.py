@@ -1,0 +1,142 @@
+"""Launcher-driven autotuning: ``deepspeed_tpu.launcher.runner --autotuning
+run|tune script.py --deepspeed_config ds.json``.
+
+Counterpart of the reference's script-relaunch flow (autotuner.py +
+autotuning/scheduler.py ResourceManager): the launcher re-runs the USER
+SCRIPT once per experiment with a mutated DS config, each run reports its
+measured throughput through a metric file (the engine writes it when
+``DS_TPU_AUTOTUNING_RESULT`` is set — reference engine's
+autotuning_metric_path), results are ranked, and mode ``run`` finally
+launches the script for real with the winning config. Single-host; the
+multi-host fan-out composes by launching through the runner itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.utils.logging import logger
+
+RESULT_ENV = "DS_TPU_AUTOTUNING_RESULT"
+END_STEP_ENV = "DS_TPU_AUTOTUNING_END_STEP"
+START_STEP_ENV = "DS_TPU_AUTOTUNING_START_STEP"
+
+
+def _find_config(user_args: List[str]) -> Tuple[Optional[int], Optional[str]]:
+    """Locate the DS config path in the script's argv (the reference reads
+    --deepspeed_config; a bare positional *.json also counts)."""
+    for i, a in enumerate(user_args):
+        if a in ("--deepspeed_config", "--deepspeed-config"):
+            if i + 1 < len(user_args):
+                return i + 1, user_args[i + 1]
+        if a.startswith("--deepspeed_config="):
+            return i, a.split("=", 1)[1]
+    for i, a in enumerate(user_args):
+        if a.endswith(".json") and os.path.exists(a):
+            return i, a
+    return None, None
+
+
+def _swapped_args(user_args: List[str], idx: int, new_path: str) -> List[str]:
+    out = list(user_args)
+    if out[idx].startswith("--deepspeed_config="):
+        out[idx] = f"--deepspeed_config={new_path}"
+    else:
+        out[idx] = new_path
+    return out
+
+
+def run_autotuning(mode: str, user_script: str, user_args: List[str],
+                   exps_dir: Optional[str] = None,
+                   timeout_s: int = 1800) -> int:
+    """Execute the tune loop; returns a process exit code."""
+    cfg_idx, cfg_path = _find_config(user_args)
+    if cfg_path is None:
+        logger.error("--autotuning needs a DS config in the script args "
+                     "(--deepspeed_config ds.json or a positional *.json)")
+        return 2
+    with open(cfg_path) as f:
+        base = json.load(f)
+    tuner = Autotuner(base)
+    exps = tuner.generate_experiments()
+    exps_dir = exps_dir or os.path.join(
+        os.path.dirname(os.path.abspath(cfg_path)), "autotuning_exps")
+    os.makedirs(exps_dir, exist_ok=True)
+    results_dir = os.path.join(os.path.dirname(exps_dir),
+                               "autotuning_results")
+    os.makedirs(results_dir, exist_ok=True)
+
+    records: List[Dict[str, Any]] = []
+    for i, exp in enumerate(exps):
+        exp_cfg = tuner.exp_to_config(exp)
+        exp_dir = os.path.join(exps_dir, f"exp_{i}")
+        os.makedirs(exp_dir, exist_ok=True)
+        exp_cfg_path = os.path.join(exp_dir, "ds_config.json")
+        with open(exp_cfg_path, "w") as f:
+            json.dump(exp_cfg, f, indent=2)
+        metric_path = os.path.join(exp_dir, "metric.json")
+        env = dict(os.environ)
+        # the relaunched script must resolve this very package, even when
+        # the parent got it via sys.path manipulation rather than install
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
+                          else []))
+        env[RESULT_ENV] = metric_path
+        env.setdefault(END_STEP_ENV,
+                       str(tuner.cfg.end_profile_step))
+        env.setdefault(START_STEP_ENV,
+                       str(tuner.cfg.start_profile_step))
+        cmd = [sys.executable, user_script] + _swapped_args(
+            user_args, cfg_idx, exp_cfg_path)
+        logger.info(f"autotuning exp {i}/{len(exps)}: {exp}")
+        log_path = os.path.join(exp_dir, "stdout.log")
+        try:
+            with open(log_path, "wb") as log_f:
+                proc = subprocess.run(
+                    cmd, env=env, timeout=timeout_s,
+                    stdout=log_f, stderr=subprocess.STDOUT)
+            ok = proc.returncode == 0 and os.path.exists(metric_path)
+        except subprocess.TimeoutExpired:
+            ok = False
+        if not ok:
+            logger.warning(f"autotuning exp {i} failed; see {log_path}")
+        rec = {"exp": exp, "config": exp_cfg_path, "ok": ok}
+        if ok:
+            with open(metric_path) as f:
+                rec.update(json.load(f))
+        records.append(rec)
+
+    scored = [r for r in records if r.get("ok") and "samples_per_sec" in r]
+    summary = {"experiments": records, "best": None}
+    code = 1
+    if scored:
+        best = max(scored, key=lambda r: r["samples_per_sec"])
+        summary["best"] = best
+        logger.info(f"autotuning best: {best['exp']} "
+                    f"({best['samples_per_sec']:.2f} samples/sec)")
+        code = 0
+    with open(os.path.join(results_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+
+    if mode == "run" and scored:
+        env = dict(os.environ)
+        env.pop(RESULT_ENV, None)
+        cmd = [sys.executable, user_script] + _swapped_args(
+            user_args, cfg_idx, summary["best"]["config"])
+        return subprocess.call(cmd, env=env)
+    return code
+
+
+def write_metric_file(path: str, samples_per_sec: float,
+                      ms_per_step: float) -> None:
+    """Engine-side: drop the measured metric where the tuner looks."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"samples_per_sec": round(float(samples_per_sec), 4),
+                   "ms_per_step": round(float(ms_per_step), 3)}, f)
+    os.replace(tmp, path)

@@ -176,6 +176,10 @@ def parse_args(argv=None):
     p.add_argument("--force_multi", action="store_true")
     p.add_argument("--dry_run", action="store_true",
                    help="print the per-host commands without launching")
+    p.add_argument("--autotuning", default="", choices=["run", "tune"],
+                   help="tune: relaunch the script per experiment and rank "
+                        "configs; run: then launch with the best one "
+                        "(reference launcher --autotuning)")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -183,6 +187,20 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+
+    if args.autotuning:
+        if (os.path.isfile(args.hostfile) or args.force_multi
+                or args.dry_run):
+            # single-host relaunch loop only: quietly dropping multi-host
+            # options would tune (and launch!) on the wrong topology
+            raise SystemExit(
+                "--autotuning does not compose with multi-host launch "
+                "options (hostfile/--force_multi/--dry_run) yet; run the "
+                "tuner on one host, then launch the winning config")
+        from deepspeed_tpu.autotuning.cli import run_autotuning
+
+        return run_autotuning(args.autotuning, args.user_script,
+                              list(args.user_args))
 
     multi_host = os.path.isfile(args.hostfile) or args.force_multi
     if multi_host:

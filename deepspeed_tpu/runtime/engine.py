@@ -410,6 +410,18 @@ class DeepSpeedEngine:
             from deepspeed_tpu.runtime.quantize import Quantizer
             self.quantizer = Quantizer.from_config(config.quantize_training)
 
+        # autotuning metric drop (reference autotuning_metric_path): when
+        # the launcher's --autotuning relaunched us, report measured
+        # throughput through the file it watches (autotuning/cli.py)
+        self._autotune_metric_path = os.environ.get(
+            "DS_TPU_AUTOTUNING_RESULT")
+        self._autotune_end_step = int(os.environ.get(
+            "DS_TPU_AUTOTUNING_END_STEP", "5"))
+        self._autotune_start_step = int(os.environ.get(
+            "DS_TPU_AUTOTUNING_START_STEP", "1"))
+        self._autotune_t0 = None
+        self._autotune_t0_step = 0
+
         # compression-aware training from the compression_training block
         # (reference compression/compress.py init_compression, which users
         # call on the model; here the engine consumes the config directly
@@ -1383,6 +1395,31 @@ class DeepSpeedEngine:
                     self._reshard_params_fn = jax.jit(
                         lambda t: t, out_shardings=self._param_shardings)
                 self._params = self._reshard_params_fn(compressed)
+        if self._autotune_metric_path is not None:
+            from deepspeed_tpu.utils.timer import fence
+
+            start = max(1, self._autotune_start_step)
+            if self.global_steps >= start and self._autotune_t0 is None:
+                # >= not ==: a script that resumes from a checkpoint may
+                # enter past the nominal window start
+                fence(self._params)
+                self._autotune_t0 = time.time()
+                self._autotune_t0_step = self.global_steps
+            elif (self.global_steps >= max(self._autotune_end_step,
+                                           self._autotune_t0_step + 1)
+                    and self._autotune_t0 is not None):
+                from deepspeed_tpu.autotuning.cli import write_metric_file
+
+                fence(self._params)
+                steps = self.global_steps - self._autotune_t0_step
+                dt = (time.time() - self._autotune_t0) / max(steps, 1)
+                gb = (self.train_micro_batch_size_per_gpu
+                      * self.topology.data_parallel_size
+                      * self.gradient_accumulation_steps)
+                write_metric_file(self._autotune_metric_path,
+                                  samples_per_sec=gb / dt,
+                                  ms_per_step=dt * 1000.0)
+                self._autotune_metric_path = None  # write once
         if self.global_steps % self._config.steps_per_print == 0:
             self._report_progress()
         # gate on enabled BEFORE the float() conversions: pulling the loss

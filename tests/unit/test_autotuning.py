@@ -177,3 +177,71 @@ class TestWidenedSearchSpace:
         assert "remat" in best.get("tpu", {})
         evaluated = [m for _, m in at.records if m is not None]
         assert len(evaluated) >= 3  # real engines ran across the space
+
+
+class TestAutotuningCLI:
+    """Launcher --autotuning flow (reference tests/unit/autotuning/
+    test_autotuning.py test_command_line + the script-relaunch loop)."""
+
+    def test_command_line(self):
+        from deepspeed_tpu.launcher.runner import parse_args
+
+        for opt in ("run", "tune"):
+            args = parse_args(
+                f"--num_nodes 1 --autotuning {opt} foo.py".split())
+            assert args.autotuning == opt
+        for bad in ("--autotuning --num_nodes 1 foo.py".split(),
+                    "--autotuning test foo.py".split(),
+                    "--autotuning".split()):
+            with pytest.raises(SystemExit):
+                parse_args(bad)
+
+    def test_tune_relaunches_script_and_ranks(self, tmp_path, eight_devices):
+        """End-to-end: two micro-batch experiments, each run of the user
+        script drops its metric file, the summary ranks them."""
+        import json
+
+        from deepspeed_tpu.autotuning.cli import run_autotuning
+
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import sys, json\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import deepspeed_tpu\n"
+            "from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig\n"
+            "cfg_path = sys.argv[sys.argv.index('--deepspeed_config') + 1]\n"
+            "cfg = GPTConfig(vocab_size=64, n_positions=32, n_embd=16,\n"
+            "                n_layer=1, n_head=2, dtype=jax.numpy.float32)\n"
+            "eng, _, _, _ = deepspeed_tpu.initialize(model=GPT(cfg),\n"
+            "                                        config=cfg_path)\n"
+            "gb = eng.train_micro_batch_size_per_gpu * \\\n"
+            "    eng.topology.data_parallel_size\n"
+            "ids = np.zeros((gb, 8), np.int32)\n"
+            "it = iter([{'input_ids': ids, 'labels': ids}] * 8)\n"
+            "for _ in range(6):\n"
+            "    eng.train_batch(it)\n")
+        ds = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9,
+            "autotuning": {"enabled": True, "end_profile_step": 5,
+                           "min_train_micro_batch_size_per_gpu": 1,
+                           "max_train_micro_batch_size_per_gpu": 2,
+                           "num_tuning_micro_batch_sizes": 2,
+                           "zero_stages": [0]},
+        }
+        cfg_path = tmp_path / "ds.json"
+        cfg_path.write_text(json.dumps(ds))
+        code = run_autotuning(
+            "tune", str(script),
+            ["--deepspeed_config", str(cfg_path)],
+            exps_dir=str(tmp_path / "exps"), timeout_s=600)
+        assert code == 0
+        summary = json.loads(
+            (tmp_path / "autotuning_results" / "summary.json").read_text())
+        assert summary["best"] is not None
+        assert summary["best"]["samples_per_sec"] > 0
+        ok_runs = [r for r in summary["experiments"] if r["ok"]]
+        assert len(ok_runs) >= 2

@@ -47,6 +47,7 @@ class TestMonitor:
 # ---------------------------------------------------------------------------
 from deepspeed_tpu.elasticity import (
     ElasticityConfigError,
+    ElasticityError,
     ElasticityIncompatibleWorldSize,
     compute_elastic_config,
     elasticity_enabled,
@@ -106,6 +107,47 @@ class TestElasticity:
             compute_elastic_config(elastic_dict(model_parallel_size=2))
         assert not elasticity_enabled({})
         assert elasticity_enabled(elastic_dict())
+
+    # reference test_elastic.py edge matrix
+    @pytest.mark.parametrize("key,value", [
+        ("micro_batch_sizes", [1, 4, -1, 2, -10]),
+        ("micro_batch_sizes", 5),
+        ("micro_batch_sizes", ["a", None, 0.5]),
+        ("micro_batch_sizes", [2, 0.5, 4]),
+    ], ids=["negatives", "not-a-list", "non-numeric", "fractional"])
+    def test_invalid_micro_batch_values(self, key, value):
+        cfg = elastic_dict()
+        cfg["elasticity"][key] = value
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg)
+
+    def test_missing_required_keys(self):
+        for missing in ("max_train_batch_size", "micro_batch_sizes"):
+            cfg = elastic_dict()
+            del cfg["elasticity"][missing]
+            with pytest.raises(ElasticityConfigError, match=missing):
+                compute_elastic_config(cfg)
+
+    def test_future_elastic_version_rejected(self):
+        with pytest.raises(ElasticityConfigError, match="not supported"):
+            compute_elastic_config(elastic_dict(version=0.3))
+
+    def test_proper_micro_batch_for_world(self):
+        # reference test_proper_mbsz: batch 32, micros [1,2,3,7], world 7
+        # resolves to micro batch 3
+        fb, gpus, mb = compute_elastic_config(
+            elastic_dict(max_train_batch_size=32,
+                         micro_batch_sizes=[1, 2, 3, 7]),
+            world_size=7, return_microbatch=True)
+        assert mb == 3
+
+    def test_v02_bad_gpus_per_node(self):
+        # reference test_model_parallel_v1/v2_invalid analogue: chips per
+        # host must divide by model parallel size under v0.2
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(
+                elastic_dict(version=0.2, num_gpus_per_node=3,
+                             model_parallel_size=2), world_size=6)
 
     def test_hcn_generation(self):
         hcns = highly_composite_numbers(1000)
