@@ -98,6 +98,12 @@ class GPTConfig:
     # An int >= 1 forces it with that token chunk size (default 2048);
     # 0/False disable.
     fused_head_ce: Any = "auto"
+    # block-sparse attention (ops.sparse_attention): a SparsityConfig
+    # restricting attention to its block layout — causality is enforced
+    # on top regardless of the layout's symmetry. Populated from the
+    # DeepSpeed "sparse_attention" config block (see models/bert.py for
+    # the encoder-side story); the decode/KV-cache path stays dense.
+    sparse_attention: Any = None
     # weight-only int8 serving (reference int8 GEMM inference kernels,
     # csrc/transformer/inference/csrc/pt_binding.cpp:1535): block matmul
     # kernels are STORED as {"q": int8, "scale": f32[out]} and dequantized
@@ -152,6 +158,12 @@ class GPTConfig:
             raise ValueError(
                 f"use_flash_attention must be True, False or 'auto'; got "
                 f"{self.use_flash_attention!r}")
+        if self.sparse_attention is not None and self.alibi:
+            raise ValueError(
+                "sparse_attention does not compose with alibi (the "
+                "block-sparse path has no positional-bias hook); a silent "
+                "dense fallback would change the model's math, so this is "
+                "rejected up front")
         if self.attention_chunk is not None and (
                 not isinstance(self.attention_chunk, int)
                 or self.attention_chunk <= 0):
@@ -397,6 +409,26 @@ class CausalSelfAttention(nn.Module):
             k = rope(k, jnp.arange(T)[None, :])
         k = repeat_kv(k)
         v = repeat_kv(v)
+
+        # block-sparse path (explicit opt-in; wins over sp/chunked/flash).
+        # Taken UNCONDITIONALLY when configured — a silent dense fallback
+        # would change the model's math between configs. Attention-prob
+        # dropout does not exist on this path (the layout already drops
+        # most of the matrix; output dropout below still applies), and
+        # ALiBi is rejected at config time.
+        if cfg.sparse_attention is not None:
+            from deepspeed_tpu.ops.sparse_attention import SparseSelfAttention
+
+            sa = SparseSelfAttention(cfg.sparse_attention,
+                                     max_seq_length=cfg.n_positions)
+            kpm = None
+            if mask is not None:
+                kpm = jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)
+            y = sa(q, k, v, key_padding_mask=kpm, causal=cfg.causal)
+            y = y.reshape(B, T, C)
+            y = nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="c_proj")(y)
+            return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
 
         # like the flash path, sp attention has no attention-prob dropout
         # (and no ALiBi bias hook)

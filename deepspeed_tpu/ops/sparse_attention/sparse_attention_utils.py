@@ -96,9 +96,9 @@ def apply_sparse_attention(model, sparse_config):
     ``sparse_config`` is the DeepSpeed ``sparse_attention`` dict (or an
     already-built :class:`SparsityConfig`). The model's config dataclass
     must expose a ``sparse_attention`` field and a ``num_attention_heads``
-    (or ``n_head``) count — BERT-style encoders here, matching the
-    reference's supported-model list
-    (sparse_attention_utils.py:37 replace_model_self_attention).
+    (or ``n_head``) count — the BERT encoder and the GPT causal trunk
+    (and every family sharing them) here; reference supported-model list:
+    sparse_attention_utils.py:37 replace_model_self_attention.
     """
     cfg = getattr(model, "config", None)
     if cfg is None or not any(f.name == "sparse_attention"
@@ -106,7 +106,8 @@ def apply_sparse_attention(model, sparse_config):
         raise NotImplementedError(
             f"{type(model).__name__} does not support sparse attention "
             f"injection (its config has no 'sparse_attention' field); "
-            f"supported: BertForPreTraining and models sharing its encoder")
+            f"supported: BertForPreTraining, GPT, and models sharing "
+            f"their encoder/trunk")
     num_heads = getattr(cfg, "num_attention_heads",
                         getattr(cfg, "n_head", None))
     sc = get_sparse_attention_config(sparse_config, num_heads)

@@ -637,11 +637,12 @@ class SparseSelfAttention:
         return self._layouts[seq_len]
 
     def __call__(self, query, key, value, key_padding_mask=None,
-                 attn_mask=None):
+                 attn_mask=None, causal=None):
         b, t, h, d = query.shape
         layout = self.get_layout(t)
-        causal = getattr(self.sparsity_config, "attention",
-                         "bidirectional") == "unidirectional"
+        if causal is None:
+            causal = getattr(self.sparsity_config, "attention",
+                             "bidirectional") == "unidirectional"
         block = self.sparsity_config.block
         if self.impl == "gather":
             return gathered_blocksparse_attention(
