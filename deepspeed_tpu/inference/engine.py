@@ -64,10 +64,15 @@ def _conform_host_quantized(host, shapes):
 def init_inference(model, config: Optional[Dict[str, Any]] = None,
                    mp_size: int = 1, dtype=None, checkpoint: Optional[str] = None,
                    replace_with_kernel_inject: bool = True, seed: int = 0,
-                   **kwargs):
-    """Build an InferenceEngine (reference deepspeed/__init__.py:225)."""
+                   ep_size: int = 1, **kwargs):
+    """Build an InferenceEngine (reference deepspeed/__init__.py:225;
+    ``ep_size`` is the reference's expert-parallel serving knob — engine.py
+    :227 builds the EP process groups, moe_inference.py:206 serves through
+    them)."""
     config = dict(config or {})
     config.setdefault("tensor_parallel", {"tp_size": mp_size})
+    if ep_size != 1:
+        config.setdefault("moe", {}).setdefault("ep_size", ep_size)
     if dtype is not None:
         config["dtype"] = dtype
     if checkpoint is not None:
@@ -82,11 +87,21 @@ class InferenceEngine:
         self._config = config
         tp_size = int(config.get("tensor_parallel", {}).get("tp_size", 1))
         self.mp_world_size = tp_size
+        # expert-parallel serving (reference inference/engine.py:227
+        # _create_ep_parallel_group + moe_inference.py:206): converted MoE
+        # expert stacks shard over the ep axis instead of replicating —
+        # an 8-expert model at ep=4 holds 2 experts' weights per chip, and
+        # GSPMD emits the dispatch/combine all-to-alls from the layer's
+        # sharding constraints
+        ep_size = int(config.get("moe", {}).get("ep_size", 1))
+        self.ep_world_size = ep_size
 
         n = len(jax.devices())
-        assert n % tp_size == 0, (
-            f"tp_size {tp_size} does not divide {n} devices")
-        self.topology = MeshTopology(tp=tp_size, dp=n // tp_size)
+        assert n % (tp_size * ep_size) == 0, (
+            f"tp_size {tp_size} x ep_size {ep_size} does not divide "
+            f"{n} devices")
+        self.topology = MeshTopology(tp=tp_size, ep=ep_size,
+                                     dp=n // (tp_size * ep_size))
         set_default_topology(self.topology)
 
         dtype = config.get("dtype")
@@ -126,15 +141,34 @@ class InferenceEngine:
                     cfg_obj, quantized_weights=True))
                 self.module = model
                 self._model_quantized = True
+            # below ~200M params decode is dispatch-bound, not weight-
+            # bandwidth-bound, and int8 measures a LOSS (gpt2_125m
+            # 0.84-0.96x, benchmarks/inference/int8_results.json); the win
+            # starts around 350M (2.88 -> 2.33 ms/token) and grows with
+            # size (1.37x at 1.3B b1). Serve as asked, but say so once.
+            try:
+                from deepspeed_tpu.models.transformer_lm import num_params
+                n_model_params = num_params(cfg_obj)
+            except Exception:
+                n_model_params = None
+            if n_model_params is not None and n_model_params < 200e6:
+                from deepspeed_tpu.utils.logging import warning_once
+
+                warning_once(
+                    f"dtype=int8 on a ~{n_model_params / 1e6:.0f}M-param "
+                    "model: decode at this size is dispatch-bound and int8 "
+                    "measures slower than bf16 (0.84-0.96x at 125M, "
+                    "benchmarks/inference/int8_results.json); the win "
+                    "starts around 350M params")
 
         # injection policy -> TP sharding rules (reference
         # _apply_injection_policy, inference/engine.py:364)
         rules = policy_for(model) if config.get(
             "replace_with_kernel_inject", True) else None
-        if self._model_quantized and tp_size > 1:
-            raise NotImplementedError(
-                "int8 quantized_weights does not compose with tp>1 yet "
-                "(tensor-parallel specs do not map the {q, scale} layout)")
+        # int8 quantized_weights composes with tp>1: ZeroShardingRules
+        # derives the {q, scale} leaf specs from the dense kernel rule
+        # (sharding.py _quantized_leaf_spec — the reference's post-slice
+        # GroupQuantizer geometry, replace_module.py:139)
         self.sharding_rules = ZeroShardingRules(
             self.topology, stage=0, tp_rules=rules)
 
@@ -156,10 +190,17 @@ class InferenceEngine:
             # params materialize directly from the checkpoint, sharded
             self._load_checkpoint(config["checkpoint"])
 
-        log_dist(f"InferenceEngine: tp={tp_size}, dtype={self.dtype}",
-                 ranks=[0])
+        log_dist(f"InferenceEngine: tp={tp_size}, ep={ep_size}, "
+                 f"dtype={self.dtype}", ranks=[0])
 
     # ------------------------------------------------------------------
+    def _compute_dtype(self):
+        """The module's compute dtype (bf16 fallback) — the dtype in-graph
+        dequant converts to and host placement casts non-quantized floating
+        leaves to; one definition so the two cannot diverge."""
+        return getattr(getattr(self.module, "config", None), "dtype",
+                       None) or jnp.bfloat16
+
     def _cast(self, params):
         if self.dtype in (jnp.float16, jnp.bfloat16):
             return jax.tree.map(lambda x: x.astype(self.dtype)
@@ -193,9 +234,7 @@ class InferenceEngine:
         from deepspeed_tpu.models.transformer_lm import \
             dequantize_block_params
 
-        compute = getattr(getattr(self.module, "config", None), "dtype",
-                          None) or jnp.bfloat16
-        return dequantize_block_params(params, compute)
+        return dequantize_block_params(params, self._compute_dtype())
 
     def _materialize(self, input_ids):
         model = self.module
@@ -234,6 +273,13 @@ class InferenceEngine:
             # happens on HOST so full-precision leaves never transit
             cast = self.dtype if self.dtype in (jnp.float16, jnp.bfloat16) \
                 else None
+            if self.dtype == jnp.int8:
+                # non-quantized floating leaves (embeddings, norms) serve
+                # at the module's compute dtype — imported fp32 would
+                # double their HBM footprint/traffic. Scales pre-cast too:
+                # dequant casts them to the same dtype in-graph, so the
+                # quantized math is unchanged.
+                cast = self._compute_dtype()
 
             def place(leaf, shape_dtype, sharding):
                 arr = np.asarray(leaf)
@@ -271,13 +317,27 @@ class InferenceEngine:
                 self._params = self._cast(self._params)
 
     # ------------------------------------------------------------------
+    def _place_batch(self, arr):
+        """Shard a [B, ...] serving batch over the mesh's data axes
+        (dp x ep) when B divides them — the inference analogue of the
+        training engine's _put_batch. For MoE models this is what makes
+        expert parallelism real: tokens live batch-sharded, so the MoE
+        dispatch/combine constraints become all-to-alls instead of local
+        slicing over a replicated copy. Indivisible batches (e.g. batch-1
+        latency serving) stay replicated."""
+        bs = int(np.prod([self.topology.size(a)
+                          for a in ("dp", "fsdp", "ep")]))
+        if bs > 1 and arr.shape[0] % bs == 0:
+            return jax.device_put(arr, self.topology.batch_sharding())
+        return arr
+
     def forward(self, input_ids, **kwargs):
         """Full forward returning logits (jit-compiled once — the CUDA-graph
         analogue)."""
         # model modules read the ambient topology at trace time (VocabEmbed
         # one-hot vs gather) — re-assert before any lazy compile
         set_default_topology(self.topology)
-        input_ids = jnp.asarray(input_ids)
+        input_ids = self._place_batch(jnp.asarray(input_ids))
         if self._params is None or not hasattr(self, "_param_shardings"):
             self._materialize(input_ids)
         if self._fwd_fn is None:
@@ -375,6 +435,19 @@ class InferenceEngine:
         lives in models/transformer_lm.py's decode attention).
         """
         set_default_topology(self.topology)
+        if getattr(getattr(self.module, "config", None),
+                   "sparse_attention", None) is not None:
+            from deepspeed_tpu.utils.logging import warning_once
+
+            # the KV-cache decode path has no sparse analogue: a model
+            # trained block-sparse is served with dense attention (strictly
+            # MORE keys visible than training saw for window/bigbird
+            # layouts — close, not identical math; docs/DIVERGENCES.md
+            # Inference section)
+            warning_once(
+                "generate() on a sparse_attention-configured model: the "
+                "KV-cache decode path runs DENSE attention (training was "
+                "block-sparse); see docs/DIVERGENCES.md")
         input_ids = jnp.asarray(input_ids)
         if attention_mask is not None:
             ids_np = np.asarray(input_ids)
@@ -416,6 +489,8 @@ class InferenceEngine:
 
         if attention_mask is None:
             attention_mask = jnp.ones(input_ids.shape, jnp.bool_)
+        input_ids = self._place_batch(input_ids)
+        attention_mask = self._place_batch(attention_mask)
         logits_last, cache = self._prefill_fn(self._params, input_ids,
                                               attention_mask)
         rng, sub = jax.random.split(rng)

@@ -112,8 +112,9 @@ class GPTConfig:
     # stays int8. Dequantizing the whole stacked [L, ...] tree outside the
     # layer scan instead materializes a full bf16 copy per decode step
     # (measured 2x SLOWER than bf16 at 1.3B). Inference-only flag, set by
-    # init_inference(dtype="int8"); tp sharding specs do not apply to the
-    # quantized layout yet.
+    # init_inference(dtype="int8"); composes with tp>1 (the {q, scale}
+    # leaves shard like the dense kernel they replace, see
+    # runtime/zero/sharding.py _quantized_leaf_spec).
     quantized_weights: bool = False
     # stochastic transformer (reference op_builder/stochastic_transformer.py,
     # ops/transformer/transformer.py:110 stochastic_mode): whole-block

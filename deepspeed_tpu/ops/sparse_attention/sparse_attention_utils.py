@@ -110,6 +110,12 @@ def apply_sparse_attention(model, sparse_config):
             f"their encoder/trunk")
     num_heads = getattr(cfg, "num_attention_heads",
                         getattr(cfg, "n_head", None))
+    if num_heads is None:
+        raise ValueError(
+            f"cannot inject sparse attention into {type(model).__name__}: "
+            f"its config ({type(cfg).__name__}) exposes neither "
+            f"'num_attention_heads' nor 'n_head', so the SparsityConfig "
+            f"head count cannot be resolved")
     sc = get_sparse_attention_config(sparse_config, num_heads)
     new_cfg = dataclasses.replace(cfg, sparse_attention=sc)
     return model.clone(config=new_cfg)

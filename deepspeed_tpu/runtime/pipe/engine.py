@@ -498,7 +498,22 @@ class PipelineEngine:
         # load could pick them up): a crash any earlier leaves the
         # previous complete set on disk
         for stale in sorted(pre_existing - written):
-            os.remove(stale)
+            try:
+                os.remove(stale)
+            except FileNotFoundError:
+                pass  # concurrently removed — already the desired state
+            except OSError as e:
+                # must not fail an otherwise-durable save, but a SURVIVING
+                # stale bounds file is not cosmetic: a later degree-changed
+                # load merges every bounds file it globs, stale included —
+                # say so loudly
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(
+                    "could not purge stale pipeline checkpoint file %s "
+                    "(%s); a later load at a different pipeline degree "
+                    "may merge its outdated layers — remove it manually",
+                    stale, e)
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))

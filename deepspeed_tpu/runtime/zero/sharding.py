@@ -79,6 +79,8 @@ class ZeroShardingRules:
             return None
         spec = self.tp_rules(path, shape)
         if spec is None:
+            spec = self._quantized_leaf_spec(path, shape)
+        if spec is None:
             return None
         # validate: strip axes whose dim is not divisible by the mesh axis size
         cleaned = []
@@ -94,6 +96,30 @@ class ZeroShardingRules:
         if all(a is None for a in cleaned):
             return None
         return PartitionSpec(*cleaned)
+
+    def _quantized_leaf_spec(self, path, shape) -> Optional[PartitionSpec]:
+        """TP specs for int8 weight-only ``{q, scale}`` leaves, derived from
+        the dense kernel rule they replace (reference composes int8 with MP
+        the same way: GroupQuantizer quantizes the already-sliced weight,
+        replace_module.py:139 after slicing at :18). ``q`` has the kernel's
+        shape, so it inherits the kernel's spec verbatim; ``scale`` is
+        per-output-column (the kernel shape minus the contraction dim), so
+        its spec is the kernel spec with dim -2 dropped — column-parallel
+        kernels shard their scales on the same output axis, row-parallel
+        kernels keep scales replicated. Both are exact: dequant is an
+        elementwise per-column product, so sharded q × broadcast scale
+        equals the sharded dense kernel."""
+        if path.endswith("/q"):
+            return self.tp_rules(path[:-len("/q")], shape)
+        if path.endswith("/scale"):
+            kshape = tuple(shape[:-1]) + (1,) + (shape[-1],)
+            kspec = self.tp_rules(path[:-len("/scale")], kshape)
+            if kspec is None:
+                return None
+            ks = list(kspec) + [None] * (len(kshape) - len(kspec))
+            del ks[-2]  # the contraction dim the scale does not carry
+            return PartitionSpec(*ks)
+        return None
 
     def param_spec(self, path, shape) -> PartitionSpec:
         tp = self._tp_spec(path, shape)
