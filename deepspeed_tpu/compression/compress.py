@@ -95,8 +95,11 @@ class Compressor:
                 if not _match(name, group.modules):
                     continue
                 w = flat[name]
-                if not hasattr(w, "ndim") or w.ndim < 2:
-                    continue  # techniques act on matrices, not biases
+                if (not hasattr(w, "ndim") or w.ndim < 2
+                        or name.endswith(".bias")):
+                    # techniques act on weight matrices; biases are skipped
+                    # even when a layer scan stacks them into 2-D [L, out]
+                    continue
                 subkey = (jax.random.fold_in(key, gi)
                           if key is not None else None)
                 flat[name] = self._apply_one(group, w, step, subkey)

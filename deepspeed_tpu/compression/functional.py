@@ -130,7 +130,14 @@ def head_pruning_mask(w: jnp.ndarray, num_heads: int,
     """Head keep-mask for an attention OUTPUT projection whose input dim
     (axis 0 of a flax [n_embd, out] kernel) is ``num_heads * head_dim`` —
     matching the reference, which prunes heads at the attn-output boundary.
-    Returns a full-shape 0/1 mask."""
+    Returns a full-shape 0/1 mask. Scan-stacked kernels ([n_layer, in,
+    out]) are masked PER LAYER (each layer keeps its own strongest heads,
+    as the reference's per-module pruning does)."""
+    if w.ndim > 2:
+        import jax
+
+        return jax.vmap(
+            lambda ww: head_pruning_mask(ww, num_heads, dense_ratio))(w)
     rows = w.shape[0]
     if rows % num_heads:
         raise ValueError(

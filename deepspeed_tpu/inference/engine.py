@@ -72,7 +72,10 @@ def init_inference(model, config: Optional[Dict[str, Any]] = None,
     config = dict(config or {})
     config.setdefault("tensor_parallel", {"tp_size": mp_size})
     if ep_size != 1:
-        config.setdefault("moe", {}).setdefault("ep_size", ep_size)
+        # copy the nested dict (the shallow config copy above would let
+        # setdefault mutate the CALLER's moe block), and overwrite like
+        # dtype/checkpoint do — an explicit argument wins over the config
+        config["moe"] = dict(config.get("moe") or {}, ep_size=ep_size)
     if dtype is not None:
         config["dtype"] = dtype
     if checkpoint is not None:

@@ -39,14 +39,28 @@ class GPTEmbed(nn.Module):
 
 class GPTPipeBlock(nn.Module):
     """hidden -> hidden (drops the MoE aux loss — pipeline GPT is dense;
-    reference pipeline examples are dense too)."""
+    reference pipeline examples are dense too).
+
+    ``layer_idx`` is the block's GLOBAL ordinal among the transformer
+    blocks: under a progressive-layer-drop schedule the keep probability
+    depends on absolute depth (deeper blocks drop more), which must not
+    change when the pipeline is cut differently."""
 
     config: GPTConfig
+    layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x, *, deterministic: bool = True, pld_theta=None):
+        pld_keep = None
+        if (pld_theta is not None and self.config.stochastic_mode
+                and not deterministic):
+            from deepspeed_tpu.models.transformer_lm import (
+                pld_keep_probability)
+
+            pld_keep = pld_keep_probability(
+                self.layer_idx, self.config.n_layer, pld_theta)
         x, _ = Block(self.config, name="block")(
-            x, deterministic=deterministic)
+            x, deterministic=deterministic, pld_keep=pld_keep)
         return x
 
 
@@ -70,7 +84,8 @@ def gpt_pipeline(config: GPTConfig, num_stages: Optional[int] = None,
     """LayerSpec list for a GPT LM + next-token loss."""
     assert not config.is_moe, "pipeline GPT is dense (use the SPMD MoE path)"
     layers = [LayerSpec(GPTEmbed, config)]
-    layers += [LayerSpec(GPTPipeBlock, config) for _ in range(config.n_layer)]
+    layers += [LayerSpec(GPTPipeBlock, config, layer_idx=i)
+               for i in range(config.n_layer)]
     layers += [LayerSpec(GPTHead, config)]
 
     def loss_fn(logits, labels):

@@ -1,3 +1,4 @@
 from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (  # noqa: F401
     CurriculumScheduler,
+    truncate_batch_to_difficulty,
 )

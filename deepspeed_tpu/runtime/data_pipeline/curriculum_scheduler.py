@@ -99,3 +99,16 @@ class CurriculumScheduler:
 
     def load_state_dict(self, sd: Dict[str, Any]):
         self.current_difficulty = sd["current_difficulty"]
+
+
+def truncate_batch_to_difficulty(batch, seqlen: int):
+    """Truncate every [B, T, ...] sequence tensor in a batch dict to the
+    scheduled seqlen difficulty — the one curriculum transform both the
+    dense and pipeline engines apply (reference engine.py:1629 curriculum
+    setup is engine-agnostic; one compiled program per distinct value)."""
+    return {
+        k: (v[:, :seqlen]
+            if getattr(v, "ndim", 0) >= 2 and v.shape[1] > seqlen
+            else v)
+        for k, v in batch.items()
+    }

@@ -1434,15 +1434,14 @@ class DeepSpeedEngine:
 
     def _apply_curriculum(self, batch):
         """Truncate sequence tensors to the scheduled difficulty (one
-        compiled program per distinct value)."""
+        compiled program per distinct value; shared with the pipeline
+        engine)."""
+        from deepspeed_tpu.runtime.data_pipeline import (
+            truncate_batch_to_difficulty)
+
         seqlen = self.curriculum_scheduler.update_difficulty(
             self.global_steps + 1)
-        return {
-            k: (v[:, :seqlen]
-                if getattr(v, "ndim", 0) >= 2 and v.shape[1] > seqlen
-                else v)
-            for k, v in batch.items()
-        }
+        return truncate_batch_to_difficulty(batch, seqlen)
 
     def train_batch(self, data_iter):
         """Full effective-batch step: gas micro steps + model update
@@ -1594,7 +1593,9 @@ class DeepSpeedEngine:
         return os.path.join(ckpt_dir, str(tag), "mp_rank_00_model_states.msgpack")
 
     def _engine_states_path(self, ckpt_dir, tag):
-        return os.path.join(ckpt_dir, str(tag), "engine_states.pkl")
+        # msgpack envelope holding pickled meta bytes (saved through the
+        # checkpoint engine so it shares the commit barrier)
+        return os.path.join(ckpt_dir, str(tag), "engine_states.msgpack")
 
     def _optim_states_path(self, ckpt_dir, tag):
         return os.path.join(
@@ -1669,8 +1670,16 @@ class DeepSpeedEngine:
         }
         import pickle
 
-        with open(self._engine_states_path(save_dir, tag), "wb") as f:
-            pickle.dump(meta, f)
+        # routed through the checkpoint engine (pickled meta as a uint8
+        # array — the engine numpy-ifies leaves, and raw bytes would come
+        # back as an undecodable |S dtype) so the meta participates in the
+        # SAME commit durability barrier as the model/optim files — a
+        # direct file write would land immediately under an async engine,
+        # and a crash before commit() could pair a new meta with the
+        # previous save's weights in a reused tag dir
+        self.checkpoint_engine.save(
+            {"meta": np.frombuffer(pickle.dumps(meta), np.uint8)},
+            self._engine_states_path(save_dir, tag))
         ls_payload = {
             "scale": np.float32(self._ls_state.scale),
             "good_steps": np.int32(self._ls_state.good_steps),
@@ -1734,8 +1743,8 @@ class DeepSpeedEngine:
         )
         import pickle
 
-        with open(self._engine_states_path(load_dir, tag), "rb") as f:
-            meta = pickle.load(f)
+        meta = pickle.loads(np.asarray(self.checkpoint_engine.load(
+            self._engine_states_path(load_dir, tag))["meta"]).tobytes())
         # a partial accumulation window from before the restore must not
         # leak into the first post-restore step
         self._host_grad_acc = None

@@ -59,7 +59,7 @@ class _StageModule(nn.Module):
     global_offset: int
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x, *, deterministic: bool = True, pld_theta=None):
         import inspect
 
         for i, spec in enumerate(self.specs):
@@ -67,10 +67,14 @@ class _StageModule(nn.Module):
                                   name=f"layer_{self.global_offset + i}",
                                   **spec.module_kwargs)
             sig = inspect.signature(spec.typename.__call__)
+            kwargs = {}
             if "deterministic" in sig.parameters:
-                x = layer(x, deterministic=deterministic)
-            else:
-                x = layer(x)
+                kwargs["deterministic"] = deterministic
+            # progressive layer drop rides through to the blocks that take
+            # it (each knows its global depth via layer_idx)
+            if pld_theta is not None and "pld_theta" in sig.parameters:
+                kwargs["pld_theta"] = pld_theta
+            x = layer(x, **kwargs)
         return x
 
 
@@ -148,6 +152,27 @@ class PipelineEngine:
                 config.optimizer.type, config.optimizer.params,
                 self._schedule_fn, use_pallas=config.tpu.use_pallas_optimizer)
         self.optimizer_adapter = self._tx  # returned from initialize()
+
+        # curriculum learning + progressive layer drop compose with the
+        # pipeline exactly as with the dense engine (reference
+        # engine.py:1629-1663 sets both up engine-agnostically): curriculum
+        # truncates the micro batches before they enter the schedule; PLD
+        # threads a per-step theta into every stage's fwd/bwd programs
+        self.curriculum_scheduler = None
+        if config.curriculum_learning.enabled:
+            from deepspeed_tpu.runtime.data_pipeline import (
+                CurriculumScheduler)
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum_learning)
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                ProgressiveLayerDrop)
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=config.progressive_layer_drop.theta,
+                gamma=config.progressive_layer_drop.gamma)
 
         self.checkpoint_engine = select_checkpoint_engine(config)
         self._rng = jax.random.PRNGKey(seed)
@@ -237,21 +262,40 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     # per-stage compiled programs
     # ------------------------------------------------------------------
+    def _use_pld(self) -> bool:
+        return self.progressive_layer_drop is not None
+
+    def _pld_theta_now(self):
+        """Host-side theta for this step (the interpreter is host-driven, so
+        unlike the dense engine's in-graph form the schedule is evaluated
+        here and passed as a traced scalar — no recompile per step)."""
+        self.progressive_layer_drop.update_state(self.global_steps)
+        return jnp.float32(self.progressive_layer_drop.get_theta())
+
     def _fwd_fn(self, s):
         if self._fwd_fns[s] is None:
             mod = self.stage_modules[s]
 
-            def f(params, x, rng):
-                return mod.apply({"params": params}, x, deterministic=False,
-                                 rngs={"dropout": rng})
+            if self._use_pld():
+                def f(params, x, rng, theta):
+                    return mod.apply({"params": params}, x,
+                                     deterministic=False,
+                                     rngs={"dropout": rng},
+                                     pld_theta=theta)
+            else:
+                def f(params, x, rng):
+                    return mod.apply({"params": params}, x,
+                                     deterministic=False,
+                                     rngs={"dropout": rng})
 
             self._fwd_fns[s] = jax.jit(f)
         return self._fwd_fns[s]
 
-    def _loss_fn(self, s, params, x, labels, rng):
+    def _loss_fn(self, s, params, x, labels, rng, theta=None):
         mod = self.stage_modules[s]
+        kw = {"pld_theta": theta} if theta is not None else {}
         out = mod.apply({"params": params}, x, deterministic=False,
-                        rngs={"dropout": rng})
+                        rngs={"dropout": rng}, **kw)
         if self.module.loss_fn is not None:
             return self.module.loss_fn(out, labels)
         return out  # last layer already returns loss
@@ -263,26 +307,32 @@ class PipelineEngine:
             mod = self.stage_modules[s]
             last = s == self.num_stages - 1
             gas = self.micro_batches
+            use_pld = self._use_pld()
 
             if last:
-                def b(params, x, labels, rng):
+                def b(params, x, labels, rng, theta=None):
                     def lf(p, xv):
-                        return self._loss_fn(s, p, xv, labels, rng) / gas
+                        return self._loss_fn(s, p, xv, labels, rng,
+                                             theta) / gas
 
                     (loss), vjp = jax.vjp(lf, params, x)
                     gp, gx = vjp(jnp.float32(1.0))
                     return gp, gx, loss * gas
             else:
-                def b(params, x, g, rng):
+                def b(params, x, g, rng, theta=None):
                     def f(p, xv):
+                        kw = {"pld_theta": theta} if theta is not None \
+                            else {}
                         return mod.apply({"params": p}, xv,
                                          deterministic=False,
-                                         rngs={"dropout": rng})
+                                         rngs={"dropout": rng}, **kw)
 
                     _, vjp = jax.vjp(f, params, x)
                     gp, gx = vjp(g)
                     return gp, gx
-            self._bwd_fns[s] = jax.jit(b)
+            # pld off: jit the 4-arg form so call sites stay uniform
+            self._bwd_fns[s] = jax.jit(b) if use_pld else jax.jit(
+                lambda params, x, gl, rng: b(params, x, gl, rng))
         return self._bwd_fns[s]
 
     def _apply_fn(self, s):
@@ -305,6 +355,17 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     # data plumbing
     # ------------------------------------------------------------------
+    def _apply_curriculum(self, batch: Dict[str, Any]):
+        """Truncate sequence tensors to the scheduled difficulty before
+        they enter the 1F1B schedule (same transform as the dense
+        engine's _apply_curriculum — shared helper so they cannot drift)."""
+        from deepspeed_tpu.runtime.data_pipeline import (
+            truncate_batch_to_difficulty)
+
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        return truncate_batch_to_difficulty(batch, seqlen)
+
     def _split_batch(self, batch: Dict[str, Any]):
         """First-stage inputs vs last-stage labels (reference loads micro
         batches at the first and last stages, pipe/engine.py:787)."""
@@ -335,7 +396,10 @@ class PipelineEngine:
         M, S = self.micro_batches, self.num_stages
         inputs, labels = [], []
         for _ in range(M):
-            x, lab = self._split_batch(next(data_iter))
+            batch = next(data_iter)
+            if self.curriculum_scheduler is not None:
+                batch = self._apply_curriculum(batch)
+            x, lab = self._split_batch(batch)
             inputs.append(self._put(x, 0))
             labels.append(self._put(lab, S - 1) if lab is not None else None)
         if not self._initialized:
@@ -344,6 +408,7 @@ class PipelineEngine:
         self._rng, step_rng = jax.random.split(self._rng)
         rngs = [[jax.random.fold_in(jax.random.fold_in(step_rng, s), m)
                  for m in range(M)] for s in range(S)]
+        theta = self._pld_theta_now() if self._use_pld() else None
         self.tput_timer.start()
 
         acts: Dict[Tuple[int, int], Any] = {}    # (stage, mb) -> stage input
@@ -359,20 +424,24 @@ class PipelineEngine:
                 elif ins.op == "forward":
                     x = acts[(s, m)]
                     if s < S - 1:
-                        out = self._fwd_fn(s)(self._params[s], x, rngs[s][m])
+                        fargs = (self._params[s], x, rngs[s][m]) + (
+                            (theta,) if theta is not None else ())
+                        out = self._fwd_fn(s)(*fargs)
                         acts[(s + 1, m)] = jax.device_put(
                             out, self.stage_topos[s + 1].batch_sharding())
                     # last stage fwd is fused into its backward (recompute)
                 elif ins.op == "backward":
                     x = acts[(s, m)]
+                    textra = (theta,) if theta is not None else ()
                     if s == S - 1:
                         gp, gx, loss = self._bwd_fn(s)(
-                            self._params[s], x, labels[m], rngs[s][m])
+                            self._params[s], x, labels[m], rngs[s][m],
+                            *textra)
                         losses.append(loss)
                     else:
                         g = grads_in.pop(m)
                         gp, gx = self._bwd_fn(s)(
-                            self._params[s], x, g, rngs[s][m])
+                            self._params[s], x, g, rngs[s][m], *textra)
                     self._acc_grads[s] = jax.tree.map(
                         jnp.add, self._acc_grads[s], gp)
                     if s > 0:
@@ -477,19 +546,46 @@ class PipelineEngine:
         assert self._initialized
         tag = tag or f"global_step{self.global_steps}"
         import glob as _glob
+        import pickle
 
         pre_existing = set(_glob.glob(os.path.join(
             save_dir, str(tag), "layer_bounds_*_model_states.msgpack")))
+        pre_existing |= set(_glob.glob(os.path.join(
+            save_dir, str(tag), "layer_bounds_*_optim_states.msgpack")))
         written = set()
         for s in range(self.num_stages):
-            path = os.path.join(
-                save_dir, str(tag),
-                f"layer_bounds_{self.stage_bounds[s]}_"
-                f"{self.stage_bounds[s+1]}_model_states.msgpack")
+            stem = (f"layer_bounds_{self.stage_bounds[s]}_"
+                    f"{self.stage_bounds[s+1]}")
+            path = os.path.join(save_dir, str(tag),
+                                f"{stem}_model_states.msgpack")
             self.checkpoint_engine.save(
                 {"module": serialization.to_state_dict(self._params[s])},
                 path)
             written.add(path)
+            # per-stage optimizer state (reference saves per-pp-rank optim
+            # states the same way, pipe/engine.py module_state_dict side)
+            opath = os.path.join(save_dir, str(tag),
+                                 f"{stem}_optim_states.msgpack")
+            self.checkpoint_engine.save(
+                {"optimizer": serialization.to_state_dict(
+                    self._opt_states[s])}, opath)
+            written.add(opath)
+        # engine counters + lr schedule: without these a resumed run
+        # silently restarts every step-indexed schedule (curriculum
+        # difficulty, PLD theta, lr warmup) from zero. Saved through the
+        # checkpoint engine (pickled bytes in a msgpack envelope) so the
+        # meta shares the commit durability barrier with the stage files.
+        meta = {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler else {}),
+            "client_state": client_state or {},
+        }
+        self.checkpoint_engine.save(
+            {"meta": np.frombuffer(pickle.dumps(meta), np.uint8)},
+            os.path.join(save_dir, str(tag), "pipe_engine_states.msgpack"))
         # durability barrier BEFORE advertising 'latest' (async engine:
         # save() only enqueues; files land at commit)
         self.checkpoint_engine.commit(tag)
@@ -519,14 +615,20 @@ class PipelineEngine:
                 f.write(str(tag))
         return True
 
-    def load_checkpoint(self, load_dir, tag=None, **_):
+    def load_checkpoint(self, load_dir, tag=None,
+                        load_optimizer_states=True, **_):
         """Reload stage params; the checkpoint's pipeline degree need not
         match this engine's. Layers are stored under GLOBAL names
         (``layer_N``) in per-stage files keyed by their layer bounds, so a
         degree change just merges every file and re-splits by the current
         bounds (reference ``checkpoint/reshape_3d_utils.py`` reshapes the
-        same way, offline; here the load does it in place)."""
+        same way, offline; here the load does it in place). Optimizer
+        state and engine counters restore at the SAME degree; a
+        degree-changed load restores params + counters and restarts the
+        optimizer state fresh (the reference reshapes optimizer states
+        offline through its universal-checkpoint tooling)."""
         import glob as _glob
+        import pickle
 
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as f:
@@ -537,7 +639,8 @@ class PipelineEngine:
             f"layer_bounds_{self.stage_bounds[s]}_"
             f"{self.stage_bounds[s + 1]}_model_states.msgpack")
             for s in range(self.num_stages)]
-        if all(os.path.exists(f) for f in exact):
+        same_degree = all(os.path.exists(f) for f in exact)
+        if same_degree:
             files = exact        # same degree: read only our own files
         else:
             files = sorted(_glob.glob(os.path.join(
@@ -561,7 +664,50 @@ class PipelineEngine:
             self._params[s] = jax.jit(
                 lambda t: t, out_shardings=self._param_shardings[s])(restored)
         self._sync_tied_params()
-        return tag, {}
+
+        client_state = {}
+        meta_path = os.path.join(load_dir, str(tag),
+                                 "pipe_engine_states.msgpack")
+        if os.path.exists(meta_path):
+            meta = pickle.loads(np.asarray(
+                self.checkpoint_engine.load(meta_path)["meta"]).tobytes())
+            self.global_steps = int(meta["global_steps"])
+            self.global_samples = int(meta["global_samples"])
+            self.micro_steps = int(meta["micro_steps"])
+            if self.lr_scheduler is not None and meta.get("lr_scheduler"):
+                self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+            client_state = meta.get("client_state", {})
+        else:
+            log_dist(f"checkpoint {tag} predates engine-state files; "
+                     "step counters not restored", ranks=[0])
+
+        if load_optimizer_states:
+            if same_degree:
+                restored_any = False
+                for s in range(self.num_stages):
+                    opath = os.path.join(
+                        load_dir, str(tag),
+                        f"layer_bounds_{self.stage_bounds[s]}_"
+                        f"{self.stage_bounds[s + 1]}_optim_states.msgpack")
+                    if not os.path.exists(opath):
+                        continue
+                    ostate = self.checkpoint_engine.load(opath)["optimizer"]
+                    restored = serialization.from_state_dict(
+                        self._opt_states[s], ostate)
+                    self._opt_states[s] = jax.jit(
+                        lambda t: t,
+                        out_shardings=self._opt_shardings[s])(restored)
+                    restored_any = True
+                if not restored_any:
+                    log_dist(f"checkpoint {tag} has no optimizer states; "
+                             "optimizer starts fresh", ranks=[0])
+            else:
+                log_dist(
+                    "pipeline degree changed since save: params restored, "
+                    "optimizer state starts fresh (reshape optimizer "
+                    "states offline via the universal checkpoint tooling)",
+                    ranks=[0])
+        return tag, client_state
 
     @property
     def params(self):
