@@ -1,0 +1,50 @@
+# Self-running gates (reference wires the same split into
+# .github/workflows/: formatting + unit suites + op pre-compile; here the
+# TPU-facing perf gate is the extra axis).
+#
+#   make quick   fast confidence: imports + the fast unit subset (~2 min,
+#                virtual CPU mesh) — what the pre-push hook runs
+#   make test    full unit suite on the 8-device virtual CPU mesh
+#   make smoke   perf regression gate on the real chip
+#                (benchmarks/smoke.py vs committed expected.json, +-10%)
+#   make check   test + smoke-if-hot-paths-changed — the full gate
+#   make hooks   install the committed .githooks (pre-push runs
+#                `make quick` + conditional smoke)
+
+PY ?= python
+# hot paths whose changes require the perf gate (the r3 regression lesson:
+# a timing change in any of these shipped unnoticed for a round)
+HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
+             deepspeed_tpu/ops deepspeed_tpu/utils/timer.py \
+             deepspeed_tpu/inference/engine.py
+
+.PHONY: quick test smoke check hooks hot-changed
+
+quick:
+	$(PY) -c "import deepspeed_tpu; import __graft_entry__; print('imports ok')"
+	$(PY) -m pytest tests/unit/test_config.py tests/unit/test_mesh.py \
+	  tests/unit/test_ops.py -q -x
+
+test:
+	$(PY) -m pytest tests/ -q
+
+smoke:
+	$(PY) benchmarks/smoke.py
+
+# exits 0 when any hot-path file differs from origin/main (or HEAD~1 when
+# no remote exists — this repo trains disconnected)
+hot-changed:
+	@base=$$(git rev-parse --verify -q origin/main || git rev-parse -q HEAD~1); \
+	if git diff --name-only $$base -- $(HOT_PATHS) | grep -q .; then \
+	  echo "hot paths changed since $$base"; exit 0; \
+	else \
+	  echo "no hot-path changes"; exit 1; \
+	fi
+
+check: test
+	@if $(MAKE) -s hot-changed; then $(MAKE) smoke; else \
+	  echo "skipping smoke (no hot-path changes)"; fi
+
+hooks:
+	git config core.hooksPath .githooks
+	@echo "hooks installed: pre-push runs 'make quick' + conditional smoke"

@@ -29,7 +29,35 @@ EXPECTED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 TOLERANCE = 0.10
 
 
-def measure(steps: int) -> dict:
+def _int8_decode_ms(trials: int = 3, tokens: int = 64) -> float:
+    """p50 per-token decode ms for 1.3B int8 (the int8_results.json
+    headline, guarded)."""
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config
+
+    cfg = gpt2_config("gpt2-1.3b", dtype=jnp.bfloat16, n_positions=256)
+    eng = deepspeed_tpu.init_inference(GPT(cfg), dtype="int8", seed=0)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(1, 128)), jnp.int32)
+
+    def fence(x):
+        return float(jnp.sum(jnp.asarray(x).astype(jnp.float32)))
+
+    fence(eng.generate(ids, max_new_tokens=tokens))  # warm/compile
+    times = []
+    for _ in range(trials):
+        t0 = time.time()
+        fence(eng.generate(ids, max_new_tokens=tokens))
+        times.append((time.time() - t0) / tokens * 1e3)
+    return float(np.percentile(times, 50))
+
+
+def measure(steps: int, fast: bool = False) -> dict:
     from benchmarks import bert_pretrain, gpt_pretrain
 
     out = {}
@@ -41,6 +69,24 @@ def measure(steps: int) -> dict:
     r = gpt_pretrain.run("gpt2-350m", seq=1024, micro=8, steps=steps,
                          remat_policy="selective")
     out["gpt2_350m_seq1024_micro8"] = r["ms_per_step"]
+    if fast:
+        return out
+    # the other committed headlines, so a regression in any of them fails
+    # a gate instead of shipping as a one-shot artifact:
+    # (a) BERT seq-512 throughput
+    r = bert_pretrain.run("bert-large", seq=512, micro=16, remat=True,
+                          remat_policy="selective", steps=steps)
+    out["bert_large_seq512_micro16"] = r["ms_per_step"]
+    # (b) block-sparse BERT at 4k (the 2.1x sparse win)
+    from benchmarks.sparse_attention_bench import run_one as sparse_run_one
+
+    out["bert_large_seq4096_micro1_bigbird"] = round(sparse_run_one(
+        {"mode": "bigbird", "block": 128, "num_random_blocks": 1,
+         "num_sliding_window_blocks": 3, "num_global_blocks": 1},
+        4096, 1, steps), 1)
+    # (c) 1.3B int8 weight-only decode
+    out["gpt2_1p3b_int8_decode_b1_ms_per_token"] = round(
+        _int8_decode_ms(), 2)
     return out
 
 
@@ -50,6 +96,9 @@ def main():
                    help="rewrite expected.json from a fresh measurement")
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--tolerance", type=float, default=TOLERANCE)
+    p.add_argument("--fast", action="store_true",
+                   help="gate only the two train-step configs (skips the "
+                        "seq512/sparse/int8 headlines)")
     args = p.parse_args()
 
     if not args.refresh and not os.path.exists(EXPECTED_PATH):
@@ -58,12 +107,19 @@ def main():
         print(f"PERF GATE FAILED: {EXPECTED_PATH} is missing — restore it "
               f"from git, or deliberately reseed with --refresh")
         return 1
-    got = measure(args.steps)
+    got = measure(args.steps, fast=args.fast)
     if args.refresh:
+        # merge, never truncate: a --fast refresh must not silently delete
+        # (and so disarm) the gates it did not re-measure
+        merged = {}
+        if os.path.exists(EXPECTED_PATH):
+            with open(EXPECTED_PATH) as f:
+                merged = json.load(f)
+        merged.update(got)
         with open(EXPECTED_PATH, "w") as f:
-            json.dump(got, f, indent=2, sort_keys=True)
+            json.dump(merged, f, indent=2, sort_keys=True)
             f.write("\n")
-        print(f"wrote {EXPECTED_PATH}: {json.dumps(got)}")
+        print(f"wrote {EXPECTED_PATH}: {json.dumps(merged)}")
         return 0
 
     with open(EXPECTED_PATH) as f:
@@ -72,6 +128,8 @@ def main():
     for name, want in sorted(expected.items()):
         have = got.get(name)
         if have is None:
+            if args.fast:
+                continue  # --fast deliberately measures a subset
             failures.append(f"{name}: no measurement (bench removed?)")
             continue
         ratio = have / want

@@ -51,8 +51,25 @@ def _swapped_args(user_args: List[str], idx: int, new_path: str) -> List[str]:
 
 def run_autotuning(mode: str, user_script: str, user_args: List[str],
                    exps_dir: Optional[str] = None,
-                   timeout_s: int = 1800) -> int:
-    """Execute the tune loop; returns a process exit code."""
+                   timeout_s: int = 1800,
+                   hosts: Optional[Dict[str, Any]] = None,
+                   final_launch=None) -> int:
+    """Execute the tune loop; returns a process exit code.
+
+    ``hosts`` (a hostname-keyed mapping — only the keys are used) turns on
+    parallel experiment scheduling: a :class:`~deepspeed_tpu.autotuning.
+    scheduler.ResourceManager` leases one host per experiment and runs up
+    to ``len(hosts)`` experiments concurrently (reference ResourceManager,
+    autotuning/scheduler.py:27). Without hosts the pool has one lease and
+    the loop is sequential on this machine.
+
+    ``final_launch``: mode ``run``'s finalizer — called with the winning
+    config path and expected to launch the real job on the REAL topology
+    (the runner passes its own multi-host relaunch). Required when hosts
+    were given: a plain local relaunch would run the production job on one
+    host with a config tuned for the pool's topology."""
+    from deepspeed_tpu.autotuning.scheduler import ResourceManager
+
     cfg_idx, cfg_path = _find_config(user_args)
     if cfg_path is None:
         logger.error("--autotuning needs a DS config in the script args "
@@ -69,8 +86,7 @@ def run_autotuning(mode: str, user_script: str, user_args: List[str],
                                "autotuning_results")
     os.makedirs(results_dir, exist_ok=True)
 
-    records: List[Dict[str, Any]] = []
-    for i, exp in enumerate(exps):
+    def launch(i: int, exp: Dict[str, Any], host: str) -> Dict[str, Any]:
         exp_cfg = tuner.exp_to_config(exp)
         exp_dir = os.path.join(exps_dir, f"exp_{i}")
         os.makedirs(exp_dir, exist_ok=True)
@@ -93,7 +109,29 @@ def run_autotuning(mode: str, user_script: str, user_args: List[str],
                        str(tuner.cfg.start_profile_step))
         cmd = [sys.executable, user_script] + _swapped_args(
             user_args, cfg_idx, exp_cfg_path)
-        logger.info(f"autotuning exp {i}/{len(exps)}: {exp}")
+        remote = host not in ("localhost", "127.0.0.1")
+        if remote:
+            # remote lease: ship the command over the ssh transport the
+            # multi-node launcher uses (one experiment owns that host's
+            # chips for its lifetime; metric files land on the SHARED
+            # filesystem the hostfile flow already assumes for configs).
+            # Everything interpolated into the remote shell line is
+            # shlex-quoted — the launcher's own ssh builder does the same.
+            import shlex
+
+            from deepspeed_tpu.launcher.multinode_runner import (
+                _shjoin)
+
+            envs = " ".join(
+                f"{k}={shlex.quote(str(v))}" for k, v in
+                [(RESULT_ENV, env[RESULT_ENV]),
+                 (END_STEP_ENV, env[END_STEP_ENV]),
+                 (START_STEP_ENV, env[START_STEP_ENV]),
+                 ("PYTHONPATH", env["PYTHONPATH"])])
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                   f"cd {shlex.quote(os.getcwd())} && {envs} "
+                   f"{_shjoin(cmd)}"]
+        logger.info(f"autotuning exp {i}/{len(exps)} on {host}: {exp}")
         log_path = os.path.join(exp_dir, "stdout.log")
         try:
             with open(log_path, "wb") as log_f:
@@ -103,13 +141,34 @@ def run_autotuning(mode: str, user_script: str, user_args: List[str],
             ok = proc.returncode == 0 and os.path.exists(metric_path)
         except subprocess.TimeoutExpired:
             ok = False
+            if remote:
+                # the timeout killed only the LOCAL ssh client; reap the
+                # remote job before the host lease returns to the pool, or
+                # the next experiment scheduled there inherits busy chips
+                import shlex
+
+                try:
+                    subprocess.run(
+                        ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                         f"pkill -f {shlex.quote(exp_cfg_path)}"],
+                        timeout=30)
+                except (subprocess.TimeoutExpired, OSError):
+                    logger.warning(
+                        f"could not reap timed-out experiment on {host}; "
+                        "subsequent experiments there may fail")
         if not ok:
             logger.warning(f"autotuning exp {i} failed; see {log_path}")
-        rec = {"exp": exp, "config": exp_cfg_path, "ok": ok}
+        rec = {"exp": exp, "config": exp_cfg_path, "ok": ok, "host": host}
         if ok:
             with open(metric_path) as f:
                 rec.update(json.load(f))
-        records.append(rec)
+        return rec
+
+    rm = ResourceManager(hosts)
+    records = rm.run(list(exps), launch)
+    records = [r if isinstance(r, dict) else
+               {"exp": exps[i], "ok": False, "error": str(r)}
+               for i, r in enumerate(records)]
 
     scored = [r for r in records if r.get("ok") and "samples_per_sec" in r]
     summary = {"experiments": records, "best": None}
@@ -124,10 +183,22 @@ def run_autotuning(mode: str, user_script: str, user_args: List[str],
         json.dump(summary, f, indent=2)
 
     if mode == "run" and scored:
+        best_cfg = summary["best"]["config"]
+        if final_launch is not None:
+            return final_launch(best_cfg)
+        if hosts:
+            # a plain local relaunch would run the production job on ONE
+            # host with a config tuned for the pool topology — exactly the
+            # silent-wrong-topology hazard the runner guard used to catch
+            logger.error(
+                "tuning finished but no multi-host finalizer was "
+                f"provided; launch the winning config yourself: "
+                f"--deepspeed_config {best_cfg} with your hostfile")
+            return 1
         env = dict(os.environ)
         env.pop(RESULT_ENV, None)
         cmd = [sys.executable, user_script] + _swapped_args(
-            user_args, cfg_idx, summary["best"]["config"])
+            user_args, cfg_idx, best_cfg)
         return subprocess.call(cmd, env=env)
     return code
 

@@ -1,0 +1,75 @@
+"""Parallel experiment scheduling over a host pool.
+
+Counterpart of reference ``autotuning/scheduler.py:27`` (ResourceManager):
+the reference keeps a queue of tuning experiments and a pool of nodes,
+assigns each experiment the nodes it needs, launches it through the
+multi-node runner, and reaps completions to free the nodes. The TPU-native
+shape is the same resource loop with the torch/NCCL specifics removed: a
+bounded worker pool drains the experiment list, each worker leases one
+host from the pool for the lifetime of its experiment (one experiment per
+host — a relaunched TPU script owns the host's chips via the per-HOST
+process model, launcher/runner.py), and results come back in experiment
+order. On a single host the pool has one lease and the schedule
+degenerates to the sequential loop.
+"""
+
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+class ResourceManager:
+    """Lease-based experiment scheduler.
+
+    ``hosts``: ordered ``{hostname: slots}`` (the ``fetch_hostfile``
+    shape); ``None``/empty means the local host only. Slots do not
+    subdivide an experiment — one experiment leases one whole host, the
+    reference's default when an experiment needs all of a node's devices.
+    """
+
+    def __init__(self, hosts: Optional[Dict[str, int]] = None,
+                 max_parallel: Optional[int] = None):
+        names = list(hosts) if hosts else ["localhost"]
+        self.hosts = names
+        self.max_parallel = min(max_parallel or len(names), len(names))
+
+    def run(self, experiments: Sequence[Any],
+            launch_fn: Callable[[int, Any, str], Any]) -> List[Any]:
+        """Run ``launch_fn(index, experiment, host)`` for every experiment,
+        at most ``max_parallel`` concurrently, never two concurrent
+        experiments on one host. Returns results in experiment order; a
+        launch_fn exception becomes that experiment's result (the loop
+        never dies half-scheduled — the reference's fault model, where a
+        failed experiment is recorded and the node is reclaimed)."""
+        results: List[Any] = [None] * len(experiments)
+        if not experiments:
+            return results
+        pool: "queue.Queue[str]" = queue.Queue()
+        for h in self.hosts[: self.max_parallel]:
+            pool.put(h)
+        work: "queue.Queue[int]" = queue.Queue()
+        for i in range(len(experiments)):
+            work.put(i)
+
+        def worker():
+            while True:
+                try:
+                    i = work.get_nowait()
+                except queue.Empty:
+                    return
+                host = pool.get()  # lease: blocks until a host frees up
+                try:
+                    results[i] = launch_fn(i, experiments[i], host)
+                except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                    results[i] = e
+                finally:
+                    pool.put(host)
+                    work.task_done()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.max_parallel)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results

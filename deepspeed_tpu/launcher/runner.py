@@ -189,18 +189,42 @@ def main(argv=None) -> int:
     args = parse_args(argv)
 
     if args.autotuning:
-        if (os.path.isfile(args.hostfile) or args.force_multi
-                or args.dry_run):
-            # single-host relaunch loop only: quietly dropping multi-host
-            # options would tune (and launch!) on the wrong topology
+        if args.force_multi or args.dry_run:
+            # these flags shape the FINAL launch topology, which the tuner
+            # re-derives per experiment; quietly dropping them would tune
+            # (and launch!) on the wrong topology
             raise SystemExit(
-                "--autotuning does not compose with multi-host launch "
-                "options (hostfile/--force_multi/--dry_run) yet; run the "
-                "tuner on one host, then launch the winning config")
-        from deepspeed_tpu.autotuning.cli import run_autotuning
+                "--autotuning does not compose with --force_multi/"
+                "--dry_run; give the tuner a hostfile instead (it "
+                "schedules experiments across those hosts in parallel)")
+        from deepspeed_tpu.autotuning.cli import (
+            _find_config,
+            _swapped_args,
+            run_autotuning,
+        )
+
+        hosts = None
+        final_launch = None
+        if os.path.isfile(args.hostfile):
+            # parallel experiment scheduling over the host pool
+            # (reference ResourceManager, autotuning/scheduler.py:27)
+            hosts = fetch_hostfile(args.hostfile)
+            hosts = parse_resource_filter(hosts, args.include,
+                                          args.exclude)
+
+            def final_launch(best_cfg, _argv=argv):
+                # mode `run` finalizer: relaunch through THIS runner with
+                # the winning config and the original multi-host options,
+                # so the production job runs on the tuned topology
+                raw = list(_argv) if _argv is not None else sys.argv[1:]
+                i = raw.index("--autotuning")
+                del raw[i:i + 2]
+                ci, _ = _find_config(raw)
+                return main(_swapped_args(raw, ci, best_cfg))
 
         return run_autotuning(args.autotuning, args.user_script,
-                              list(args.user_args))
+                              list(args.user_args), hosts=hosts,
+                              final_launch=final_launch)
 
     multi_host = os.path.isfile(args.hostfile) or args.force_multi
     if multi_host:
