@@ -438,19 +438,26 @@ class InferenceEngine:
         lives in models/transformer_lm.py's decode attention).
         """
         set_default_topology(self.topology)
-        if getattr(getattr(self.module, "config", None),
-                   "sparse_attention", None) is not None:
-            from deepspeed_tpu.utils.logging import warning_once
+        mcfg = getattr(self.module, "config", None)
+        if getattr(mcfg, "sparse_attention", None) is not None:
+            # window(+leading-global) layouts decode through the ring KV
+            # cache — the training sparse math exactly (transformer_lm
+            # sparse_kv_cache); only layouts a ring cannot express (e.g.
+            # BigBird's random links) fall back to dense decode, which
+            # sees strictly MORE keys than training did — close, not
+            # identical math (docs/DIVERGENCES.md Inference section)
+            from deepspeed_tpu.ops.sparse_attention. \
+                sparse_attention_utils import ring_engaged
 
-            # the KV-cache decode path has no sparse analogue: a model
-            # trained block-sparse is served with dense attention (strictly
-            # MORE keys visible than training saw for window/bigbird
-            # layouts — close, not identical math; docs/DIVERGENCES.md
-            # Inference section)
-            warning_once(
-                "generate() on a sparse_attention-configured model: the "
-                "KV-cache decode path runs DENSE attention (training was "
-                "block-sparse); see docs/DIVERGENCES.md")
+            if ring_engaged(mcfg) is None:
+                from deepspeed_tpu.utils.logging import warning_once
+
+                warning_once(
+                    "generate() on a sparse_attention-configured model: "
+                    "this layout decodes with DENSE attention (training "
+                    "was block-sparse); window/longformer layouts decode "
+                    "sparse-exactly via the ring KV cache — see "
+                    "docs/DIVERGENCES.md")
         input_ids = jnp.asarray(input_ids)
         if attention_mask is not None:
             ids_np = np.asarray(input_ids)

@@ -217,8 +217,22 @@ def main(argv=None) -> int:
                 # the winning config and the original multi-host options,
                 # so the production job runs on the tuned topology
                 raw = list(_argv) if _argv is not None else sys.argv[1:]
-                i = raw.index("--autotuning")
-                del raw[i:i + 2]
+                # strip --autotuning in every argparse spelling (exact,
+                # '=value', prefix abbreviation) — but only among the
+                # RUNNER's options, i.e. tokens before the user script
+                script_at = raw.index(args.user_script)
+                kept, skip = [], False
+                for j, tok in enumerate(raw[:script_at]):
+                    if skip:
+                        skip = False
+                        continue
+                    base = tok.split("=", 1)[0]
+                    if (base.startswith("--a") and len(base) >= 3
+                            and "--autotuning".startswith(base)):
+                        skip = "=" not in tok
+                        continue
+                    kept.append(tok)
+                raw = kept + raw[script_at:]
                 ci, _ = _find_config(raw)
                 return main(_swapped_args(raw, ci, best_cfg))
 

@@ -102,8 +102,17 @@ class GPTConfig:
     # restricting attention to its block layout — causality is enforced
     # on top regardless of the layout's symmetry. Populated from the
     # DeepSpeed "sparse_attention" config block (see models/bert.py for
-    # the encoder-side story); the decode/KV-cache path stays dense.
+    # the encoder-side story).
     sparse_attention: Any = None
+    # layout-aware KV cache for decode: window(+leading-global) layouts
+    # retain only the G + (w+1)*block slots the layout can ever attend
+    # (a block-granular ring), reproducing the TRAINING sparse math
+    # exactly while cutting cache memory n_positions/(G+(w+1)*block)-fold.
+    # "auto" engages when the layout is expressible (sliding-window,
+    # leading-global longformer) and the ring is smaller than the dense
+    # cache; True demands it (ValueError if the layout cannot express
+    # it — e.g. BigBird's random links); False always decodes dense.
+    sparse_kv_cache: Any = "auto"
     # weight-only int8 serving (reference int8 GEMM inference kernels,
     # csrc/transformer/inference/csrc/pt_binding.cpp:1535): block matmul
     # kernels are STORED as {"q": int8, "scale": f32[out]} and dequantized
@@ -171,6 +180,22 @@ class GPTConfig:
             raise ValueError(
                 f"attention_chunk must be a positive int or None; got "
                 f"{self.attention_chunk!r}")
+        if self.sparse_kv_cache not in ("auto", True, False):
+            raise ValueError(
+                f"sparse_kv_cache must be 'auto', True or False; got "
+                f"{self.sparse_kv_cache!r}")
+        if self.sparse_kv_cache is True:
+            from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+                import ring_decode_params
+
+            if (self.sparse_attention is None
+                    or ring_decode_params(self.sparse_attention) is None):
+                raise ValueError(
+                    "sparse_kv_cache=True needs a ring-expressible layout "
+                    "(causal sliding-window, or longformer with leading "
+                    "global blocks); BigBird's random links cannot be "
+                    "served from a bounded ring — use 'auto' to fall back "
+                    "to the dense cache")
 
     @property
     def head_dim(self) -> int:
@@ -341,6 +366,87 @@ class CausalSelfAttention(nn.Module):
             if not cfg.causal:
                 raise NotImplementedError(
                     "decode path requires a causal model")
+            # layout-aware compact KV cache: when the sparse layout is a
+            # causal window (+ leading globals), decode retains ONLY the
+            # slots the layout can ever attend — a block-granular ring —
+            # and reproduces the TRAINING block-sparse visibility exactly
+            # (the dense-cache path below attends strictly more keys than
+            # a window-trained model saw). See GPTConfig.sparse_kv_cache.
+            from deepspeed_tpu.ops.sparse_attention. \
+                sparse_attention_utils import ring_engaged
+
+            ring = ring_engaged(cfg)
+            if ring is not None:
+                w_blk, g_tok, blk = ring
+                ring_len = (w_blk + 1) * blk
+                S = g_tok + ring_len
+                cached_k = self.variable(
+                    "cache", "cached_key", jnp.zeros,
+                    (B, S, Hkv, D), cfg.dtype)
+                cached_v = self.variable(
+                    "cache", "cached_value", jnp.zeros,
+                    (B, S, Hkv, D), cfg.dtype)
+                cache_valid = self.variable(
+                    "cache", "valid", jnp.zeros, (B, S), jnp.bool_)
+                slot_pos = self.variable(
+                    "cache", "slot_pos",
+                    lambda: jnp.full((S,), -1, jnp.int32))
+                cache_index = self.variable(
+                    "cache", "cache_index",
+                    lambda: jnp.zeros((), jnp.int32))
+                idx = cache_index.value
+                pos = idx + jnp.arange(T)                     # [T]
+                if cfg.rotary:
+                    q, k = rope(q, pos[None, :]), rope(k, pos[None, :])
+                # ring slot for every token; with T > ring_len only the
+                # last ring_len tokens may land (S is out-of-bounds ->
+                # scatter mode="drop"); leading-global tokens ALSO land in
+                # their dedicated slot (the ring copy is masked out of
+                # visibility below, so nothing double-counts)
+                ring_slot = jnp.where(pos >= idx + T - ring_len,
+                                      g_tok + pos % ring_len, S)
+                glob_slot = jnp.where(pos < g_tok, pos, S)
+                write_valid = (mask.astype(jnp.bool_) if mask is not None
+                               else jnp.ones((B, T), jnp.bool_))
+                kc, vc = k.astype(cfg.dtype), v.astype(cfg.dtype)
+                for slots in (ring_slot, glob_slot):
+                    cached_k.value = cached_k.value.at[:, slots].set(
+                        kc, mode="drop")
+                    cached_v.value = cached_v.value.at[:, slots].set(
+                        vc, mode="drop")
+                    cache_valid.value = cache_valid.value.at[
+                        :, slots].set(write_valid, mode="drop")
+                    slot_pos.value = slot_pos.value.at[slots].set(
+                        pos, mode="drop")
+                cache_index.value = idx + T
+                k_all, v_all = cached_k.value, cached_v.value
+
+                G = H // Hkv
+                qg = q.reshape(B, T, Hkv, G, D)
+                scale = 1.0 / np.sqrt(D)
+                att = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all) * scale
+                q_pos = pos[:, None]                          # [T, 1]
+                ps = slot_pos.value[None, :]                  # [1, S]
+                s_idx = jnp.arange(S)[None, :]
+                is_glob = s_idx < g_tok
+                in_window = (ps // blk) >= (q_pos // blk) - w_blk
+                visible = ((ps >= 0) & (ps <= q_pos)
+                           & (is_glob | (in_window & (ps >= g_tok))))
+                visible = (visible[None, None, None]          # [1,1,1,T,S]
+                           & cache_valid.value[:, None, None, None, :])
+                att = jnp.where(visible, att, jnp.finfo(att.dtype).min)
+                # NaN-safe: a prefill query older than the ring (its own
+                # key already evicted) has an empty visible set; its
+                # output is garbage by design (only the tail logits are
+                # consumed) but must not produce NaN
+                att = jax.nn.softmax(
+                    att.astype(jnp.float32), axis=-1,
+                    where=visible).astype(cfg.dtype)
+                y = jnp.einsum("bhgqk,bkhd->bqhgd", att, v_all)
+                y = y.reshape(B, T, C)
+                return nn.Dense(C, use_bias=bias, dtype=cfg.dtype,
+                                param_dtype=cfg.param_dtype,
+                                name="c_proj")(y)
             # KV-cache append + attend (the reference's softmax_context
             # kernel with its inference_context.h cache management,
             # csrc/transformer/inference/). Chunk-aware: prefill writes T

@@ -146,3 +146,58 @@ def unpad_sequence_output(pad_len: int, sequence_output):
     if pad_len == 0:
         return sequence_output
     return sequence_output[:, :-pad_len]
+
+
+def ring_decode_params(sparsity_config):
+    """``(past_window_blocks, global_tokens, block)`` when the layout's
+    DECODE-time visibility is expressible as "a sliding window of whole
+    blocks plus a contiguous run of leading global blocks" — the shape a
+    ring KV cache can serve exactly — else ``None``.
+
+    Expressible: :class:`LocalSlidingWindowSparsityConfig` (pure causal
+    window) and causal :class:`BSLongformerSparsityConfig` whose global
+    blocks are a leading contiguous run. BigBird is NOT expressible: its
+    per-row random links reach arbitrary past blocks, which a bounded
+    ring cannot retain. Fixed/variable patterns' row-block structure
+    likewise exceeds window+globals.
+    """
+    sc = sparsity_config
+    if isinstance(sc, LocalSlidingWindowSparsityConfig):
+        if sc.attention != "unidirectional":
+            return None
+        return sc.num_sliding_window_blocks // 2, 0, sc.block
+    if isinstance(sc, BSLongformerSparsityConfig):
+        if sc.attention != "unidirectional":
+            return None
+        idx = list(sc.global_block_indices)
+        if sc.global_block_end_indices is None:
+            spans = [(g, g + 1) for g in idx]
+        else:
+            spans = list(zip(idx, sc.global_block_end_indices))
+        blocks = sorted({b for s, e in spans for b in range(s, e)})
+        if blocks != list(range(len(blocks))):
+            return None  # globals not a leading contiguous run
+        return (sc.num_sliding_window_blocks // 2, len(blocks) * sc.block,
+                sc.block)
+    return None
+
+
+def ring_engaged(model_cfg):
+    """The ONE decision both the model's decode path and the inference
+    engine's divergence warning consult: the ring parameters when this
+    model config will decode through the compact layout-aware KV cache,
+    else ``None`` (dense decode). Keeping it here prevents the two call
+    sites from drifting — a stale copy would warn "decodes DENSE" while
+    the model rings, or stay silent while it fell back."""
+    sc = getattr(model_cfg, "sparse_attention", None)
+    if sc is None:
+        return None
+    if getattr(model_cfg, "sparse_kv_cache", False) not in ("auto", True):
+        return None
+    ring = ring_decode_params(sc)
+    if ring is None:
+        return None
+    w_blk, g_tok, blk = ring
+    if g_tok + (w_blk + 1) * blk >= model_cfg.n_positions:
+        return None  # ring would not be smaller than the dense cache
+    return ring

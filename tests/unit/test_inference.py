@@ -394,6 +394,152 @@ class TestExpertParallelInference:
         assert any(crosses_ep(l) for l in colls), colls[:6]
 
 
+class TestSparseRingKVCache:
+    """Layout-aware KV cache: window(+leading-global) sparse layouts
+    decode from a block-granular ring holding only the attendable slots,
+    reproducing the TRAINING block-sparse math exactly (the dense cache
+    cannot — it sees strictly more keys than a window-trained model)."""
+
+    def _sparse_model(self, sparse, n_positions=256, **kw):
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+            import apply_sparse_attention
+
+        return apply_sparse_attention(
+            GPT(_cfg(n_positions=n_positions, **kw)), sparse)
+
+    @pytest.mark.parametrize("layout", ["window", "longformer"])
+    def test_decode_matches_training_sparse_forward(self, layout):
+        """Prefill + stepwise ring decode must equal the TRAINING sparse
+        forward at every position — across several ring wraparounds."""
+        sparse = ({"mode": "local_sliding_window", "block": 16,
+                   "num_sliding_window_blocks": 3}
+                  if layout == "window" else
+                  {"mode": "bslongformer", "block": 16,
+                   "num_sliding_window_blocks": 3,
+                   "attention": "unidirectional"})
+        model = self._sparse_model(sparse)
+        rng = np.random.RandomState(11)
+        T = 144  # block 16, w=1 -> ring 32 slots: several wraparounds
+        ids = jnp.asarray(rng.randint(0, 128, size=(2, T)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids,
+                            deterministic=True)["params"]
+
+        full = model.apply({"params": params}, ids, deterministic=True)
+
+        # ring is 32 (+16 globals for longformer) slots — prefill 24
+        # tokens (< ring) so every prefill logit is exact, then decode
+        # one-by-one deep past the window
+        pre_t = 24
+        pre, cache = model.apply({"params": params}, ids[:, :pre_t],
+                                 deterministic=True, decode=True,
+                                 mutable=["cache"])
+        cache = cache["cache"]
+        np.testing.assert_allclose(
+            np.asarray(pre), np.asarray(full[:, :pre_t]),
+            atol=2e-4, rtol=1e-3)
+        for t in range(pre_t, T):
+            step, cache = model.apply(
+                {"params": params, "cache": cache}, ids[:, t:t + 1],
+                deterministic=True, decode=True, mutable=["cache"])
+            cache = cache["cache"]
+            np.testing.assert_allclose(
+                np.asarray(step[:, 0]), np.asarray(full[:, t]),
+                atol=2e-4, rtol=1e-3, err_msg=f"position {t} ({layout})")
+
+    def test_cache_is_ring_sized(self):
+        model = self._sparse_model(
+            {"mode": "local_sliding_window", "block": 16,
+             "num_sliding_window_blocks": 3}, n_positions=1024)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        vs = model.init(jax.random.PRNGKey(0), ids, deterministic=True,
+                        decode=True)
+        flat = {"/".join(str(k) for k in p): v for p, v in
+                jax.tree_util.tree_flatten_with_path(vs["cache"])[0]}
+        k_shapes = {p: v.shape for p, v in flat.items()
+                    if "cached_key" in p}
+        assert k_shapes
+        # ring = (w+1)*block = 32 slots, not n_positions=1024: 32x less
+        # cache memory (slots axis is -3: [*, B, S, Hkv, D] with a
+        # leading layer axis under scan_layers)
+        for p, s in k_shapes.items():
+            assert s[-3] == 32 and 1024 not in s, (p, s)
+
+    def test_ragged_ring_decode_matches_solo(self):
+        model = self._sparse_model(
+            {"mode": "local_sliding_window", "block": 16,
+             "num_sliding_window_blocks": 3})
+        import deepspeed_tpu
+
+        eng = deepspeed_tpu.init_inference(model, dtype="fp32", seed=0)
+        rng = np.random.RandomState(12)
+        # block-divisible prompt lengths of >= 3 blocks: the engine's
+        # param-shape init traces the TRAINING sparse forward, whose
+        # layout needs T % block == 0 and enough blocks for the window
+        # (serving callers pad via pad_to_block_size)
+        lens = [48, 64]
+        prompts = [rng.randint(0, 128, size=(1, n)).astype(np.int32)
+                   for n in lens]
+        singles = [np.asarray(eng.generate(jnp.asarray(p),
+                                           max_new_tokens=40))
+                   for p in prompts]
+        T = max(lens)
+        ids = np.zeros((2, T), np.int32)
+        mask = np.zeros((2, T), bool)
+        for b, p in enumerate(prompts):
+            ids[b, :lens[b]] = p[0]
+            mask[b, :lens[b]] = True
+        batched = np.asarray(eng.generate(
+            jnp.asarray(ids), max_new_tokens=40,
+            attention_mask=jnp.asarray(mask)))
+        for b in range(2):
+            np.testing.assert_array_equal(batched[b], singles[b][0],
+                                          err_msg=f"seq {b}")
+
+    def test_bigbird_falls_back_dense_with_warning(self, caplog):
+        import logging
+
+        import deepspeed_tpu
+        from deepspeed_tpu.utils.logging import _warn_once_cached
+
+        model = self._sparse_model(
+            {"mode": "bigbird", "block": 16,
+             "attention": "unidirectional"})
+        eng = deepspeed_tpu.init_inference(model, dtype="fp32", seed=0)
+        # 48 = 3 blocks: bigbird's window needs >= 3 layout blocks
+        ids = jnp.asarray(
+            np.random.RandomState(13).randint(0, 128, size=(1, 48)),
+            jnp.int32)
+        _warn_once_cached.cache_clear()
+        pkg_logger = logging.getLogger("deepspeed_tpu")
+        pkg_logger.propagate = True
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="deepspeed_tpu"):
+                out = eng.generate(ids, max_new_tokens=3)
+        finally:
+            pkg_logger.propagate = False
+        assert out.shape == (1, 3)
+        assert any("DENSE" in r.message for r in caplog.records)
+        # and the dense cache really is full-length (no ring engaged)
+        vs = eng.module.init(jax.random.PRNGKey(0), ids,
+                             deterministic=True, decode=True)
+        ck = [v for p, v in jax.tree_util.tree_flatten_with_path(
+            vs["cache"])[0]
+            if "cached_key" in "/".join(str(k) for k in p)]
+        assert ck and all(
+            c.shape[-3] == eng.module.config.n_positions for c in ck)
+
+    def test_sparse_kv_cache_true_rejects_bigbird(self):
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+            import get_sparse_attention_config
+
+        sc = get_sparse_attention_config(
+            {"mode": "bigbird", "block": 16,
+             "attention": "unidirectional"}, 4)
+        with pytest.raises(ValueError, match="ring-expressible"):
+            _cfg(sparse_attention=sc, sparse_kv_cache=True)
+
+
 class TestDecodeDivergenceWarnings:
     def test_sparse_model_generate_warns_dense_decode(self, caplog):
         """A sparse_attention-trained model decodes dense (the KV-cache
