@@ -26,8 +26,10 @@ Measured (v5e chip, GPT-2 125M micro 1):
   11.32->10.43 over 6 steps at 3.16 s/step, DOUBLE the chunked ceiling
   at a quarter of the 32k chunked step time. The gather form has no
   length-proportional scan in its backward, which was the 64k compile
-  blocker; full dense-equivalent attention at this length remains the
-  sequence-parallel axis's job (parallel/sequence.py ring/Ulysses).
+  blocker. seq 131072 hits the compile helper's memory limit (HTTP 500)
+  at both block 64/window 17 and block 128/window 9 — 64k is this
+  toolchain's single-chip ceiling; past it, sequence parallelism
+  (parallel/sequence.py ring/Ulysses) is the axis that scales.
 """
 
 import json
