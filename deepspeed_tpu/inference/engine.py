@@ -439,6 +439,13 @@ class InferenceEngine:
         """
         set_default_topology(self.topology)
         mcfg = getattr(self.module, "config", None)
+        # ONE ring decision for this call: drives both the dense-decode
+        # divergence warning and the streaming cap below (shared helper —
+        # the model's decode branch consults the same one)
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+            import ring_engaged
+
+        ring = ring_engaged(mcfg) if mcfg is not None else None
         if getattr(mcfg, "sparse_attention", None) is not None:
             # window(+leading-global) layouts decode through the ring KV
             # cache — the training sparse math exactly (transformer_lm
@@ -446,10 +453,7 @@ class InferenceEngine:
             # BigBird's random links) fall back to dense decode, which
             # sees strictly MORE keys than training did — close, not
             # identical math (docs/DIVERGENCES.md Inference section)
-            from deepspeed_tpu.ops.sparse_attention. \
-                sparse_attention_utils import ring_engaged
-
-            if ring_engaged(mcfg) is None:
+            if ring is None:
                 from deepspeed_tpu.utils.logging import warning_once
 
                 warning_once(
@@ -484,13 +488,21 @@ class InferenceEngine:
             raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
         if max_new_tokens == 0:
             return jnp.zeros((input_ids.shape[0], 0), jnp.int32)
-        max_pos = getattr(getattr(self.module, "config", None),
-                          "n_positions", None)
+        max_pos = getattr(mcfg, "n_positions", None)
         if max_pos is not None and input_ids.shape[1] + max_new_tokens > max_pos:
-            raise ValueError(
-                f"prompt ({input_ids.shape[1]}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds the KV cache capacity "
-                f"(n_positions={max_pos})")
+            # streaming decode: a ring-cached model with no learned
+            # position table (rotary/ALiBi-free-running positions) has
+            # nothing that saturates at n_positions — the ring evicts old
+            # window blocks and globals persist (the attention-sink
+            # pattern), so generation length is unbounded at O(window)
+            # memory. Models with a wpe table keep the hard cap.
+            streaming = (ring is not None
+                         and not getattr(mcfg, "learned_positions", True))
+            if not streaming:
+                raise ValueError(
+                    f"prompt ({input_ids.shape[1]}) + max_new_tokens "
+                    f"({max_new_tokens}) exceeds the KV cache capacity "
+                    f"(n_positions={max_pos})")
         if self._params is None or not hasattr(self, "_param_shardings"):
             self._materialize(input_ids)
         if self._prefill_fn is None:

@@ -584,6 +584,42 @@ class TestSparseRingKVCache:
                                                           ids))
         np.testing.assert_array_equal(toks, ref_toks)
 
+    def test_streaming_decode_past_n_positions(self):
+        """Ring-cached rotary models stream: no wpe table saturates, the
+        ring evicts old window blocks, globals persist (attention sinks)
+        — so generation runs PAST n_positions at O(window) memory. Ground
+        truth: a rotary model's params don't depend on n_positions, so a
+        same-seed engine with a 64x larger cap must emit the identical
+        stream."""
+        import deepspeed_tpu
+        from deepspeed_tpu.parallel import mesh as mesh_mod
+
+        sparse = {"mode": "bslongformer", "block": 16,
+                  "num_sliding_window_blocks": 3,
+                  "attention": "unidirectional"}
+        kw = dict(rotary=True, learned_positions=False)
+        rng = np.random.RandomState(15)
+        ids = jnp.asarray(rng.randint(0, 128, size=(1, 48)), jnp.int32)
+
+        small = self._sparse_model(sparse, n_positions=64, **kw)
+        eng_s = deepspeed_tpu.init_inference(small, dtype="fp32", seed=0)
+        # 48 + 100 = 148 tokens >> n_positions=64
+        toks_s = np.asarray(eng_s.generate(ids, max_new_tokens=100))
+        assert toks_s.shape == (1, 100)
+
+        mesh_mod.reset_default_topology()
+        big = self._sparse_model(sparse, n_positions=4096, **kw)
+        eng_b = deepspeed_tpu.init_inference(big, dtype="fp32", seed=0)
+        toks_b = np.asarray(eng_b.generate(ids, max_new_tokens=100))
+        np.testing.assert_array_equal(toks_s, toks_b)
+
+        # a wpe model keeps the hard cap: its position table saturates
+        mesh_mod.reset_default_topology()
+        wpe = self._sparse_model(sparse, n_positions=64)
+        eng_w = deepspeed_tpu.init_inference(wpe, dtype="fp32", seed=0)
+        with pytest.raises(ValueError, match="exceeds the KV cache"):
+            eng_w.generate(ids, max_new_tokens=100)
+
     def test_sparse_kv_cache_true_rejects_bigbird(self):
         from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
             import get_sparse_attention_config
