@@ -127,6 +127,42 @@ def run_case(name):
                               param_dtype=jnp.float32, scan_layers=False,
                               moe_num_experts=8, moe_top_k=2))
         it = _token_batches(16)  # dp_size = ep = 8; micro 2 each
+    elif name == "infer_int8_tp8":
+        # int8 weight-only SERVING with tp=8 spanning both processes:
+        # the {q, scale} shards and the row-parallel activation psums
+        # cross the host boundary every forward
+        from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+        model = GPT(GPTConfig(vocab_size=128, n_positions=32, n_embd=64,
+                              n_layer=2, n_head=8, dtype=jnp.bfloat16))
+        eng = deepspeed_tpu.init_inference(model, mp_size=8,
+                                           dtype="int8", seed=0)
+        rng = np.random.RandomState(0)
+        out = []
+        for _ in range(STEPS):
+            ids = jnp.asarray(rng.randint(0, 128, size=(2, 16)), jnp.int32)
+            logits = eng.forward(ids).astype(jnp.float32)
+            # scalar digests are replicated, so every process can read
+            # them (the logits themselves are vocab-sharded over tp)
+            out.append(float(jnp.mean(jnp.abs(logits))))
+        return out
+    elif name == "infer_moe_ep8":
+        # expert-parallel SERVING over ep=8: the expert group spans both
+        # processes, so dispatch/combine collectives cross hosts
+        from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+        model = GPT(GPTConfig(vocab_size=128, n_positions=32, n_embd=32,
+                              n_layer=2, n_head=4, dtype=jnp.float32,
+                              param_dtype=jnp.float32,
+                              moe_num_experts=8, moe_top_k=2,
+                              moe_eval_capacity_factor=4.0))
+        eng = deepspeed_tpu.init_inference(model, ep_size=8,
+                                           dtype="fp32", seed=0)
+        rng = np.random.RandomState(0)
+        out = []
+        for _ in range(STEPS):
+            ids = jnp.asarray(rng.randint(0, 128, size=(8, 16)), jnp.int32)
+            logits = eng.forward(ids)
+            out.append(float(jnp.mean(jnp.abs(logits))))
+        return out
     else:
         raise ValueError(name)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
@@ -234,3 +270,21 @@ def test_two_process_training_matches_single_host(case, eight_devices,
     np.testing.assert_allclose(per_proc[0], per_proc[1], rtol=1e-6)
     # … and the cross-process run matches the single-host 8-device mesh.
     np.testing.assert_allclose(per_proc[0], losses_ref, rtol=1e-4)
+
+
+@pytest.mark.parametrize("case", ["infer_int8_tp8", "infer_moe_ep8"])
+def test_two_process_serving_matches_single_host(case, eight_devices,
+                                                 tmp_path):
+    """Inference across a REAL process boundary: int8 x tp=8 (quantized
+    shards + row-parallel psums cross hosts) and expert-parallel ep=8
+    serving (dispatch/combine cross hosts) produce the single-host
+    logit digests (reference inference MP/EP groups over NCCL;
+    engine.py:227)."""
+    digests_ref = _single_process_reference(case)
+    assert all(np.isfinite(digests_ref)), digests_ref
+    # non-vacuous: all-zero logits would satisfy every allclose below
+    assert digests_ref[0] > 1e-3, digests_ref
+
+    per_proc = _spawn_pair(case, tmp_path)
+    np.testing.assert_allclose(per_proc[0], per_proc[1], rtol=1e-6)
+    np.testing.assert_allclose(per_proc[0], digests_ref, rtol=2e-3)
