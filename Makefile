@@ -7,6 +7,9 @@
 #   make test    full unit suite on the 8-device virtual CPU mesh
 #   make smoke   perf regression gate on the real chip
 #                (benchmarks/smoke.py vs committed expected.json, +-10%)
+#   make chaos   fault-injection suite: torn/failed checkpoint writes,
+#                preemption grace saves, crash-loop detection
+#                (docs/recovery.md)
 #   make check   test + smoke-if-hot-paths-changed — the full gate
 #   make hooks   install the committed .githooks (pre-push runs
 #                `make quick` + conditional smoke)
@@ -18,7 +21,7 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/ops deepspeed_tpu/utils/timer.py \
              deepspeed_tpu/inference/engine.py
 
-.PHONY: quick test smoke check hooks hot-changed
+.PHONY: quick test smoke chaos check hooks hot-changed
 
 quick:
 	$(PY) -c "import deepspeed_tpu; import __graft_entry__; print('imports ok')"
@@ -30,6 +33,9 @@ test:
 
 smoke:
 	$(PY) benchmarks/smoke.py
+
+chaos:
+	$(PY) -m pytest tests/unit/test_fault_tolerance.py -q
 
 # exits 0 when any hot-path file differs from BASE (override: `make
 # hot-changed BASE=<sha>` — the pre-push hook passes the remote sha so a

@@ -13,10 +13,17 @@ TPU re-design supervises ONE process per host around slice preemption:
   world-size changes — the reference's core elasticity invariant;
 * workers are expected to resume from their latest checkpoint
   (``load_checkpoint(tag='latest')``), which is the reference's recovery
-  path too — the agent only guarantees a consistent relaunch env.
+  path too — the agent guarantees a consistent relaunch env and, when a
+  checkpoint dir is known, advertises the newest MANIFEST-VALID tag via
+  ``DS_TPU_LAST_VALID_TAG`` so a torn newest tag cannot wedge recovery;
+* restart hygiene for preemption storms: exponential backoff with jitter
+  (capped), a restart-budget reset after a configurable stable-run
+  window, and crash-loop detection (N failures inside T seconds aborts
+  with a clear error instead of burning the budget on a doomed relaunch).
 """
 
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -24,11 +31,18 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.runtime import checkpoint_manifest
 from deepspeed_tpu.utils.logging import logger
 
 
 class ElasticAgentError(RuntimeError):
     pass
+
+
+class CrashLoopError(ElasticAgentError):
+    """Worker is failing faster than it can make progress; restarting
+    again would only mask the root cause (e.g. a corrupt config, a
+    permanently wedged checkpoint, an OOMing model)."""
 
 
 class DSElasticAgent:
@@ -48,11 +62,34 @@ class DSElasticAgent:
         the TPU slice/pod state after repair.
     max_restarts / backoff_s:
         restart budget for non-zero worker exits (preemption, slice loss).
+        Delays grow exponentially from ``backoff_s`` (capped at
+        ``max_backoff_s``) with ``±jitter`` relative noise so a pod's
+        agents don't restart in lockstep after a shared outage.
+    stable_window_s:
+        when set, a worker that ran at least this long before failing
+        resets the restart budget — long-lived jobs should survive any
+        number of WELL-SPACED preemptions without exhausting a fixed
+        budget. None keeps the strict cumulative budget.
+    crash_loop_window_s / crash_loop_threshold:
+        when the window is set, ``crash_loop_threshold`` failures inside
+        it abort with :class:`CrashLoopError` — a persistently-crashing
+        worker (bad config, wedged checkpoint) must fail loudly, not
+        retry forever under a budget that stable-run resets keep
+        refilling.
+    ckpt_dir:
+        checkpoint root; on every (re)launch the newest manifest-valid
+        tag is exported as ``DS_TPU_LAST_VALID_TAG`` so the worker can
+        recover even when the newest tag / 'latest' pointer is torn.
     """
 
     def __init__(self, cmd: List[str], ds_config: Dict,
                  discover_world: Optional[Callable[[], int]] = None,
                  max_restarts: int = 3, backoff_s: float = 5.0,
+                 max_backoff_s: float = 60.0, jitter: float = 0.1,
+                 stable_window_s: Optional[float] = None,
+                 crash_loop_window_s: Optional[float] = None,
+                 crash_loop_threshold: int = 3,
+                 ckpt_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None):
         self.cmd = list(cmd)
         self.ds_config = ds_config
@@ -60,15 +97,32 @@ class DSElasticAgent:
             lambda: int(os.environ.get("DS_TPU_NUM_PROCS", "1")))
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.jitter = jitter
+        self.stable_window_s = stable_window_s
+        self.crash_loop_window_s = crash_loop_window_s
+        self.crash_loop_threshold = crash_loop_threshold
+        self.ckpt_dir = ckpt_dir
         self.env = dict(env if env is not None else os.environ)
         self.restart_count = 0
+        self._failure_times: List[float] = []
         self._proc: Optional[subprocess.Popen] = None
+        self._sleep = time.sleep  # seam for tests
 
     # ------------------------------------------------------------------
     def _worker_env(self, world: int) -> Dict[str, str]:
         env = dict(self.env)
         env["DS_TPU_NUM_PROCS"] = str(world)
         env["DS_TPU_ELASTIC_RESTART"] = str(self.restart_count)
+        if self.ckpt_dir:
+            # advertise the newest MANIFEST-VALID tag: the worker's
+            # load_checkpoint falls back to it when the 'latest' pointer
+            # is missing, and operators can inspect it in the env
+            tag = checkpoint_manifest.latest_valid_tag(self.ckpt_dir)
+            if tag is not None:
+                env[checkpoint_manifest.LAST_VALID_TAG_ENV] = tag
+                logger.info(f"elastic relaunch: last valid checkpoint "
+                            f"tag is {tag}")
         elastic = self.ds_config.get("elasticity")
         if elastic and elastic.get("enabled"):
             # re-solve the batch triad for the new world size so
@@ -102,11 +156,39 @@ class DSElasticAgent:
             raise ElasticAgentError(f"discovered world size {world} < 1")
         return subprocess.Popen(self.cmd, env=self._worker_env(world))
 
+    def _next_backoff(self) -> float:
+        """Exponential backoff with jitter: base * 2^(restarts-1), capped,
+        then ±jitter relative noise (decorrelates agents across a pod)."""
+        delay = min(self.backoff_s * (2 ** max(self.restart_count - 1, 0)),
+                    self.max_backoff_s)
+        if self.jitter > 0:
+            delay *= 1.0 + random.uniform(-self.jitter, self.jitter)
+        return max(delay, 0.0)
+
+    def _check_crash_loop(self, now: float):
+        if self.crash_loop_window_s is None:
+            return
+        cutoff = now - self.crash_loop_window_s
+        self._failure_times = [t for t in self._failure_times if t >= cutoff]
+        if len(self._failure_times) >= self.crash_loop_threshold:
+            raise CrashLoopError(
+                f"crash loop detected: {len(self._failure_times)} worker "
+                f"failures within {self.crash_loop_window_s:.0f}s "
+                f"(threshold {self.crash_loop_threshold}). The worker is "
+                f"failing faster than it can make progress — aborting "
+                f"instead of restarting; inspect the worker logs and the "
+                f"checkpoint dir"
+                + (f" ({self.ckpt_dir})" if self.ckpt_dir else "") + ".")
+
     # ------------------------------------------------------------------
     def run(self) -> int:
-        """Supervision loop: returns the final exit code (0 on success)."""
+        """Supervision loop: returns the final exit code (0 on success).
+
+        Raises :class:`CrashLoopError` when failures cluster tighter than
+        ``crash_loop_threshold`` per ``crash_loop_window_s``."""
         while True:
             self._proc = self._launch()
+            started = time.monotonic()
             try:
                 rc = self._proc.wait()
             except KeyboardInterrupt:
@@ -115,17 +197,31 @@ class DSElasticAgent:
                 return 1
             if rc == 0:
                 return 0
+            now = time.monotonic()
+            run_s = now - started
+            self._failure_times.append(now)
+            self._check_crash_loop(now)
+            if (self.stable_window_s is not None
+                    and run_s >= self.stable_window_s
+                    and self.restart_count > 0):
+                logger.info(
+                    f"worker ran {run_s:.0f}s (>= stable window "
+                    f"{self.stable_window_s:.0f}s) before failing; "
+                    f"resetting restart budget")
+                self.restart_count = 0
             if self.restart_count >= self.max_restarts:
                 logger.error(
                     f"worker failed (rc={rc}) and restart budget "
                     f"({self.max_restarts}) is exhausted")
                 return rc
             self.restart_count += 1
+            delay = self._next_backoff()
             logger.warning(
-                f"worker failed (rc={rc}); elastic restart "
-                f"{self.restart_count}/{self.max_restarts} in "
-                f"{self.backoff_s:.0f}s")
-            time.sleep(self.backoff_s)
+                f"worker failed (rc={rc}) after {run_s:.1f}s; elastic "
+                f"restart {self.restart_count}/{self.max_restarts} in "
+                f"{delay:.1f}s")
+            if delay > 0:
+                self._sleep(delay)
 
 
 def main(argv=None) -> int:
@@ -138,6 +234,18 @@ def main(argv=None) -> int:
     p.add_argument("--config", default=None)
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--backoff", type=float, default=5.0)
+    p.add_argument("--max_backoff", type=float, default=60.0)
+    p.add_argument("--jitter", type=float, default=0.1)
+    p.add_argument("--stable_window", type=float, default=None,
+                   help="seconds of stable running that reset the "
+                        "restart budget (default: never reset)")
+    p.add_argument("--crash_loop_window", type=float, default=None,
+                   help="abort when --crash_loop_threshold failures land "
+                        "within this many seconds")
+    p.add_argument("--crash_loop_threshold", type=int, default=3)
+    p.add_argument("--ckpt_dir", default=None,
+                   help="checkpoint root; the newest manifest-valid tag "
+                        "is exported to workers as DS_TPU_LAST_VALID_TAG")
     p.add_argument("cmd", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
@@ -147,8 +255,13 @@ def main(argv=None) -> int:
     if args.config:
         with open(args.config) as f:
             cfg = json.load(f)
-    agent = DSElasticAgent(cmd, cfg, max_restarts=args.max_restarts,
-                           backoff_s=args.backoff)
+    agent = DSElasticAgent(
+        cmd, cfg, max_restarts=args.max_restarts, backoff_s=args.backoff,
+        max_backoff_s=args.max_backoff, jitter=args.jitter,
+        stable_window_s=args.stable_window,
+        crash_loop_window_s=args.crash_loop_window,
+        crash_loop_threshold=args.crash_loop_threshold,
+        ckpt_dir=args.ckpt_dir)
     return agent.run()
 
 

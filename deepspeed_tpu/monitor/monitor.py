@@ -19,6 +19,14 @@ from deepspeed_tpu.utils.logging import logger
 Event = Tuple[str, float, int]
 
 
+def counter_events(prefix: str, counters, step: int) -> List[Event]:
+    """Shape a dict of monotonic counters into monitor events
+    (``prefix/name``), the wall_clock_breakdown-style export used for the
+    engine's fault-tolerance stats (saves/loads/fallbacks/retries)."""
+    return [(f"{prefix}/{name}", float(value), step)
+            for name, value in sorted(counters.items())]
+
+
 class Monitor:
     """Backend interface (reference monitor/monitor.py Monitor ABC)."""
 

@@ -19,14 +19,23 @@ import jax
 import numpy as np
 from flax import serialization
 
+from deepspeed_tpu.runtime import checkpoint_manifest as cm
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 class CheckpointEngine:
-    """ABC surface of the reference checkpoint engine."""
+    """ABC surface of the reference checkpoint engine.
+
+    Every ``save()`` between two ``commit()`` calls records the written
+    file's size + crc32; ``commit(tag)`` turns the records for the tag's
+    directory into a durable ``manifest.json`` — the integrity proof
+    ``load_checkpoint`` verifies before trusting the tag."""
 
     def __init__(self, config_params=None):
-        pass
+        # written by save()/the async writer thread, drained by commit()
+        self._manifest_lock = threading.Lock()
+        self._manifest_files: Dict[str, Dict[str, Dict[str, object]]] = {}
+        self.io_retry_count = 0
 
     def create(self, tag: str):
         log_dist(f"[ckpt] checkpointing tag {tag}", ranks=[0])
@@ -39,6 +48,26 @@ class CheckpointEngine:
 
     def commit(self, tag: str) -> bool:
         return True
+
+    # -- manifest bookkeeping -------------------------------------------
+    def _record_write(self, path: str, digest: Dict[str, object]):
+        d, name = os.path.dirname(path), os.path.basename(path)
+        with self._manifest_lock:
+            self._manifest_files.setdefault(d, {})[name] = digest
+
+    def _drop_records(self):
+        with self._manifest_lock:
+            self._manifest_files = {}
+
+    def _commit_manifests(self, tag: str):
+        """Write one manifest per recorded TAG directory. Files saved
+        outside a ``<tag>``-named dir (e.g. save_16bit_model exports) are
+        not part of the tag's integrity contract and are dropped."""
+        with self._manifest_lock:
+            recorded, self._manifest_files = self._manifest_files, {}
+        for d, files in recorded.items():
+            if os.path.basename(d) == str(tag):
+                cm.write_manifest(d, tag, files)
 
 
 def _to_host(tree):
@@ -62,21 +91,24 @@ def select_checkpoint_engine(config) -> "CheckpointEngine":
 
 
 def _write_atomic(host_state, path: str):
-    """Serialize + atomically replace ``path`` (shared by sync and async
-    engines so durability fixes land in one place)."""
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    """Serialize + durably replace ``path`` (shared by sync and async
+    engines so durability fixes land in one place): fsync before
+    ``os.replace`` and fsync the parent dir after, so a committed tag
+    survives power loss; transient OSErrors retry with exponential
+    backoff (checkpoint_manifest.retry_io). Returns ``(digest, retries)``
+    for manifest recording."""
     payload = serialization.msgpack_serialize(host_state)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)
+    retries = cm.atomic_write_bytes(path, payload)
+    return cm.payload_digest(payload), retries
 
 
 class MsgpackCheckpointEngine(CheckpointEngine):
     """Default engine: flax msgpack files (≈ TorchCheckpointEngine)."""
 
     def save(self, state_dict: Dict[str, Any], path: str):
-        _write_atomic(_to_host(state_dict), path)
+        digest, retries = _write_atomic(_to_host(state_dict), path)
+        self._record_write(path, digest)
+        self.io_retry_count += retries
         log_dist(f"[ckpt] saved {path}", ranks=[0])
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
@@ -84,6 +116,7 @@ class MsgpackCheckpointEngine(CheckpointEngine):
             return serialization.msgpack_restore(f.read())
 
     def commit(self, tag: str) -> bool:
+        self._commit_manifests(tag)
         return True
 
 
@@ -116,7 +149,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 return
             host_state, path, done = item
             try:
-                _write_atomic(host_state, path)
+                digest, retries = _write_atomic(host_state, path)
+                self._record_write(path, digest)
+                self.io_retry_count += retries
                 log_dist(f"[ckpt] async saved {path}", ranks=[0])
             except Exception as e:  # surfaced at commit()
                 with self._lock:
@@ -125,6 +160,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 done.set()
 
     def save(self, state_dict: Dict[str, Any], path: str):
+        # snapshot-and-enqueue UNCONDITIONALLY: an earlier write failure
+        # must not silently drop later files — every failure is
+        # accumulated and surfaced together at commit()/load()
         host_state = _to_host(state_dict)  # consistent snapshot, blocking
         done = threading.Event()
         with self._lock:
@@ -147,6 +185,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
         with self._lock:
             errors, self._errors = self._errors, []
         if errors:
+            # the tag is invalid: its successful files must not be
+            # certified by a manifest at the next commit
+            self._drop_records()
             paths = ", ".join(p for p, _ in errors)
             raise RuntimeError(
                 f"async checkpoint write failed for {len(errors)} "
@@ -155,6 +196,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
     def commit(self, tag: str) -> bool:
         self.wait()
         self._raise_errors()
+        self._commit_manifests(tag)
         log_dist(f"[ckpt] tag {tag} committed (all async writes durable)",
                  ranks=[0])
         return True

@@ -261,6 +261,33 @@ class PipelineConfig(ConfigModel):
 
 
 @dataclass
+class GracefulShutdownConfig(ConfigModel):
+    """Preemption grace handler (no reference analogue; docs/recovery.md).
+    When enabled, the engine traps ``signals`` and, at the next step
+    boundary, saves + commits a final checkpoint to ``save_dir`` before
+    exiting — turning a slice preemption into a clean resume point."""
+
+    enabled: bool = False
+    save_dir: Optional[str] = None
+    tag: Optional[str] = None  # None -> the default global_step<N> tag
+    signals: List[str] = field(default_factory=lambda: ["SIGTERM", "SIGINT"])
+    exit_after_save: bool = True
+    exit_code: int = 0
+
+    def __post_init__validate__(self):
+        if self.enabled and not self.save_dir:
+            raise DeepSpeedConfigError(
+                "graceful_shutdown.enabled requires graceful_shutdown."
+                "save_dir (where the final checkpoint goes)")
+        import signal as _signal
+
+        for name in self.signals:
+            if not hasattr(_signal, str(name)):
+                raise DeepSpeedConfigError(
+                    f"graceful_shutdown.signals: unknown signal {name!r}")
+
+
+@dataclass
 class MeshConfig(ConfigModel):
     """TPU device-mesh axis sizes. -1 on ``dp`` means "use all remaining
     devices". No reference analogue: replaces mpu/process-group plumbing
@@ -421,6 +448,16 @@ class DeepSpeedConfig:
         self.load_universal_checkpoint = ckpt.get(
             C.LOAD_UNIVERSAL_CHECKPOINT, C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT
         )
+        self.checkpoint_keep_n = int(ckpt.get(
+            C.CHECKPOINT_KEEP_N, C.CHECKPOINT_KEEP_N_DEFAULT))
+        if self.checkpoint_keep_n < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint.keep_n must be >= 0 (0 = keep all), got "
+                f"{self.checkpoint_keep_n}")
+        self.checkpoint_verify = bool(ckpt.get(
+            C.CHECKPOINT_VERIFY, C.CHECKPOINT_VERIFY_DEFAULT))
+        self.graceful_shutdown = GracefulShutdownConfig.from_dict(
+            pd.get(C.GRACEFUL_SHUTDOWN, {}))
 
         if self.dp_world_size is not None:
             self._resolve_batch_triad(self.dp_world_size)

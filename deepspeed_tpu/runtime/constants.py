@@ -117,6 +117,15 @@ CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
 CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
 LOAD_UNIVERSAL_CHECKPOINT = "load_universal"
 LOAD_UNIVERSAL_CHECKPOINT_DEFAULT = False
+# fault-tolerance knobs (no reference analogue; docs/recovery.md)
+CHECKPOINT_KEEP_N = "keep_n"
+CHECKPOINT_KEEP_N_DEFAULT = 0  # 0 = keep every tag
+CHECKPOINT_VERIFY = "verify"
+CHECKPOINT_VERIFY_DEFAULT = True
+
+# Preemption-aware shutdown block (docs/recovery.md): a SIGTERM/SIGINT
+# grace handler that saves + commits a final checkpoint before exit.
+GRACEFUL_SHUTDOWN = "graceful_shutdown"
 
 DATALOADER_DROP_LAST = "dataloader_drop_last"
 DATALOADER_DROP_LAST_DEFAULT = False

@@ -23,6 +23,9 @@ partition_parameters.py:537 hijacks nn.Module.__init__ for this).
 """
 
 import os
+import shutil
+import signal as signal_module
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -39,6 +42,7 @@ from deepspeed_tpu.parallel.mesh import (
     set_default_topology,
     topology_from_config,
 )
+from deepspeed_tpu.runtime import checkpoint_manifest as ckpt_manifest
 from deepspeed_tpu.runtime.checkpoint_engine import (
     CheckpointEngine,
     select_checkpoint_engine,
@@ -382,6 +386,22 @@ class DeepSpeedEngine:
         self.wall_clock_breakdown = config.wall_clock_breakdown
 
         self.monitor = self._configure_monitor()
+
+        # fault-tolerance telemetry (wall_clock_breakdown-style counters,
+        # exported through the monitor as FaultTolerance/* events)
+        self.ft_stats = {
+            "ckpt_saves": 0,
+            "ckpt_loads": 0,
+            "ckpt_fallbacks": 0,
+            "graceful_shutdowns": 0,
+        }
+        # preemption grace handler (config-gated): the signal handler only
+        # sets a flag; the save happens at the next step boundary where
+        # host-side counters and device state are consistent
+        self._preempt_signum = None
+        self._old_signal_handlers = {}
+        if config.graceful_shutdown.enabled:
+            self._install_signal_handlers()
 
         # module-level activation checkpointing (reference engine.py:818
         # _configure_checkpointing): models that call
@@ -1431,6 +1451,8 @@ class DeepSpeedEngine:
                   float(np.mean([float(l) for l in step_losses])),
                   self.global_samples)]
             )
+        if self._preempt_signum is not None:
+            self._graceful_shutdown()
 
     def _apply_curriculum(self, batch):
         """Truncate sequence tensors to the scheduled difficulty (one
@@ -1587,6 +1609,67 @@ class DeepSpeedEngine:
         )
 
     # ------------------------------------------------------------------
+    # preemption-aware shutdown (no reference analogue; docs/recovery.md)
+    # ------------------------------------------------------------------
+    def _install_signal_handlers(self):
+        cfg = self._config.graceful_shutdown
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning(
+                "graceful_shutdown: not on the main thread; signal "
+                "handlers not installed")
+            return
+        for name in cfg.signals:
+            signum = getattr(signal_module, str(name))
+            self._old_signal_handlers[signum] = signal_module.signal(
+                signum, self._signal_handler)
+        log_dist(
+            f"graceful_shutdown armed for {list(cfg.signals)} -> "
+            f"{cfg.save_dir}", ranks=[0])
+
+    def _restore_signal_handlers(self):
+        handlers, self._old_signal_handlers = self._old_signal_handlers, {}
+        for signum, old in handlers.items():
+            try:
+                signal_module.signal(signum, old)
+            except (ValueError, TypeError):
+                pass
+
+    def _signal_handler(self, signum, frame):
+        # async-signal context: only set the flag; the actual save runs at
+        # the next step boundary (_post_step_bookkeeping)
+        self._preempt_signum = signum
+        logger.warning(
+            "received signal %s: will checkpoint and exit at the next "
+            "step boundary", signal_module.Signals(signum).name)
+
+    def _graceful_shutdown(self):
+        """Final save + commit, then exit (config-gated). Runs on the
+        normal host control path, never inside the signal handler."""
+        cfg = self._config.graceful_shutdown
+        signum, self._preempt_signum = self._preempt_signum, None
+        self._restore_signal_handlers()  # a second signal kills normally
+        log_dist(
+            f"graceful shutdown (signal "
+            f"{signal_module.Signals(signum).name}): saving final "
+            f"checkpoint at step {self.global_steps}", ranks=[0])
+        self.save_checkpoint(cfg.save_dir, tag=cfg.tag)
+        self.ft_stats["graceful_shutdowns"] += 1
+        self._emit_ft_events()
+        if cfg.exit_after_save:
+            raise SystemExit(cfg.exit_code)
+
+    def _emit_ft_events(self):
+        if self.monitor is None or not getattr(self.monitor, "enabled",
+                                               False):
+            return
+        from deepspeed_tpu.monitor.monitor import counter_events
+
+        counters = dict(self.ft_stats)
+        counters["ckpt_io_retries"] = self.checkpoint_engine.io_retry_count
+        self.monitor.write_events(
+            counter_events("FaultTolerance", counters, self.global_steps))
+
+    # ------------------------------------------------------------------
     # checkpoint (reference engine.py:2545 load / :2889 save)
     # ------------------------------------------------------------------
     def _model_states_path(self, ckpt_dir, tag):
@@ -1704,9 +1787,30 @@ class DeepSpeedEngine:
         # pointer must never name a tag whose files haven't durably landed
         self.checkpoint_engine.commit(tag)
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+            ckpt_manifest.write_latest(save_dir, tag)
+        self.ft_stats["ckpt_saves"] += 1
+        self._gc_checkpoints(save_dir)
+        self._emit_ft_events()
         return True
+
+    def _gc_checkpoints(self, save_dir):
+        """Retention policy ``checkpoint.keep_n``: keep the newest N valid
+        tags; never delete the tag the ``latest`` pointer names (a GC race
+        must not take down the reference recovery path)."""
+        keep_n = self._config.checkpoint_keep_n
+        if keep_n <= 0:
+            return
+        protected = {ckpt_manifest.read_latest(save_dir)} - {None}
+        tags = ckpt_manifest.find_valid_tags(save_dir, check_data=False)
+        for tag in tags[keep_n:]:
+            if tag in protected:
+                continue
+            try:
+                shutil.rmtree(os.path.join(save_dir, tag))
+                log_dist(f"[ckpt] retention keep_n={keep_n}: removed old "
+                         f"tag {tag}", ranks=[0])
+            except OSError as e:
+                logger.warning("checkpoint GC failed for %s: %s", tag, e)
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.msgpack"):
         """Gathered half-precision weights in one file (reference
@@ -1724,15 +1828,49 @@ class DeepSpeedEngine:
         )
         return True
 
+    def _resolve_valid_tag(self, load_dir, tag):
+        """Verify ``tag`` against its manifest; on mismatch/missing files
+        fall back to the newest previous valid tag instead of crashing
+        (the recovery path after a torn write or preempted save). Tags
+        without a manifest (pre-manifest checkpoints) load unverified."""
+        if not self._config.checkpoint_verify:
+            return tag
+        tag_dir = os.path.join(load_dir, str(tag))
+        problems = ckpt_manifest.verify_tag_dir(tag_dir)
+        if problems is None:
+            logger.info(
+                "checkpoint tag %s has no manifest (pre-manifest "
+                "checkpoint); loading unverified", tag)
+            return tag
+        if not problems:
+            return tag
+        logger.warning(
+            "checkpoint tag %s failed integrity verification (%s); "
+            "falling back to the newest previous valid tag",
+            tag, "; ".join(problems))
+        fallback = ckpt_manifest.latest_valid_tag(
+            load_dir, exclude={str(tag)})
+        if fallback is None:
+            raise RuntimeError(
+                f"checkpoint tag {tag!r} at {load_dir} is corrupt "
+                f"({'; '.join(problems)}) and no previous valid tag "
+                f"exists to fall back to")
+        self.ft_stats["ckpt_fallbacks"] += 1
+        log_dist(f"[ckpt] falling back: {tag} -> {fallback}", ranks=[0])
+        return fallback
+
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True):
         if tag is None:
-            latest_path = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest_path):
+            tag = ckpt_manifest.read_latest(load_dir)
+            if tag is None:
+                # a relaunched elastic worker may know the last-valid tag
+                # even when the 'latest' pointer is gone/unreadable
+                tag = os.environ.get(ckpt_manifest.LAST_VALID_TAG_ENV)
+            if tag is None:
                 logger.warning("no 'latest' file at %s", load_dir)
                 return None, {}
-            with open(latest_path) as f:
-                tag = f.read().strip()
+        tag = self._resolve_valid_tag(load_dir, tag)
 
         assert self._initialized, (
             "run one forward (or init) before load_checkpoint so state "
@@ -1802,4 +1940,6 @@ class DeepSpeedEngine:
                     good_steps=jnp.int32(ls["good_steps"]),
                     hysteresis=jnp.int32(ls["hysteresis"]),
                 )
+        self.ft_stats["ckpt_loads"] += 1
+        self._emit_ft_events()
         return tag, meta.get("client_state", {})

@@ -611,8 +611,9 @@ class PipelineEngine:
                     "may merge its outdated layers — remove it manually",
                     stale, e)
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+            from deepspeed_tpu.runtime import checkpoint_manifest
+
+            checkpoint_manifest.write_latest(save_dir, tag)
         return True
 
     def load_checkpoint(self, load_dir, tag=None,

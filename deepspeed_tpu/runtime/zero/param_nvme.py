@@ -419,8 +419,9 @@ class NVMeParamEngine:
                 "swap_meta": self.store.swapper._meta,
                 "client_state": client_state or {},
             }, f)
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(str(tag))
+        from deepspeed_tpu.runtime import checkpoint_manifest
+
+        checkpoint_manifest.write_latest(save_dir, tag)
         return True
 
     def load_checkpoint(self, load_dir, tag=None):

@@ -1,0 +1,166 @@
+"""Reusable fault-injection harness for chaos-testing the storage and
+supervision paths (docs/recovery.md).
+
+Checkpoint durability code is exactly the code that never runs in a happy
+CI: torn writes, transient IO errors, and mid-step kills only happen on
+real pods at the worst possible moment. These context managers make those
+failures reproducible in unit tests:
+
+* :func:`failing_writes` — write-mode ``open()`` on matching paths raises
+  (transiently for the first N calls, or permanently);
+* :func:`torn_writes` — ``os.replace`` truncates the source file first,
+  simulating a torn write that still got renamed (filesystem corruption,
+  power loss without fsync);
+* :func:`truncate_file` — post-hoc corruption of a file on disk;
+* :func:`kill_at_step` — deliver a signal to a supervised child when a
+  step file it writes reaches a chosen step (preemption at step K).
+
+Everything here is process-global monkeypatching of ``builtins.open`` /
+``os.replace`` — test-only machinery, deliberately free of jax imports so
+agent/supervisor tests stay light.
+"""
+
+import builtins
+import os
+import signal as signal_module
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional, Union
+
+Matcher = Optional[Union[str, Callable[[str], bool]]]
+
+_WRITE_MODE_CHARS = set("wxa+")
+
+
+class Injector:
+    """Handle yielded by the context managers: ``injected`` counts the
+    faults actually delivered (assert on it to prove the fault fired)."""
+
+    def __init__(self):
+        self.injected = 0
+        self._lock = threading.Lock()
+
+    def _bump(self):
+        with self._lock:
+            self.injected += 1
+
+
+def _to_matcher(match: Matcher) -> Callable[[str], bool]:
+    if match is None:
+        return lambda path: True
+    if callable(match):
+        return match
+    return lambda path, needle=str(match): needle in path
+
+
+def _path_str(file) -> str:
+    try:
+        return os.fspath(file) if not isinstance(file, int) else ""
+    except TypeError:
+        return ""
+
+
+@contextmanager
+def failing_writes(match: Matcher = None, fail_times: Optional[int] = None,
+                   exc: Callable[[str], BaseException] = None):
+    """Make write-mode ``open()`` calls on matching paths raise.
+
+    ``fail_times=None`` fails permanently; ``fail_times=N`` fails the
+    first N matching opens then lets writes through (a transient blip the
+    retry loop should absorb). Read-mode opens are never touched.
+    """
+    injector = Injector()
+    matcher = _to_matcher(match)
+    make_exc = exc or (lambda p: OSError(f"injected write failure: {p}"))
+    real_open = builtins.open
+
+    def fake_open(file, mode="r", *args, **kwargs):
+        path = _path_str(file)
+        if (path and (_WRITE_MODE_CHARS & set(mode)) and matcher(path)
+                and (fail_times is None or injector.injected < fail_times)):
+            injector._bump()
+            raise make_exc(path)
+        return real_open(file, mode, *args, **kwargs)
+
+    builtins.open = fake_open
+    try:
+        yield injector
+    finally:
+        builtins.open = real_open
+
+
+@contextmanager
+def torn_writes(match: Matcher = None, keep_fraction: float = 0.5,
+                fail_times: Optional[int] = None):
+    """Truncate the source file of matching ``os.replace`` calls before
+    renaming — the rename lands but the content is torn, which is what a
+    crash between write and fsync leaves behind on real filesystems."""
+    injector = Injector()
+    matcher = _to_matcher(match)
+    real_replace = os.replace
+
+    def fake_replace(src, dst, **kwargs):
+        src_s, dst_s = _path_str(src), _path_str(dst)
+        if ((matcher(dst_s) or matcher(src_s))
+                and (fail_times is None or injector.injected < fail_times)):
+            truncate_file(src_s, keep_fraction=keep_fraction)
+            injector._bump()
+        return real_replace(src, dst, **kwargs)
+
+    os.replace = fake_replace
+    try:
+        yield injector
+    finally:
+        os.replace = real_replace
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5,
+                  keep_bytes: Optional[int] = None) -> int:
+    """Corrupt ``path`` in place by truncation (torn-write aftermath).
+    Returns the new size."""
+    size = os.path.getsize(path)
+    keep = keep_bytes if keep_bytes is not None else int(size * keep_fraction)
+    keep = max(0, min(keep, size))
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
+
+
+@contextmanager
+def kill_at_step(proc, step_file: str, step: int,
+                 sig: int = signal_module.SIGTERM, poll_s: float = 0.02,
+                 timeout_s: float = 120.0):
+    """Deliver ``sig`` to a supervised child once the step counter it
+    writes to ``step_file`` reaches ``step`` (preemption at a chosen
+    point). The child contract: overwrite ``step_file`` with its current
+    integer step. Yields an Injector whose ``injected`` is 1 after the
+    signal fired."""
+    injector = Injector()
+    stop = threading.Event()
+
+    def watch():
+        deadline = time.monotonic() + timeout_s
+        while not stop.is_set() and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                return  # child already exited
+            try:
+                with open(step_file) as f:
+                    current = int(f.read().strip() or -1)
+            except (OSError, ValueError):
+                current = -1
+            if current >= step:
+                try:
+                    proc.send_signal(sig)
+                finally:
+                    injector._bump()
+                return
+            time.sleep(poll_s)
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    try:
+        yield injector
+    finally:
+        stop.set()
+        watcher.join(timeout=timeout_s)

@@ -140,3 +140,71 @@ def test_unknown_keys_tolerated():
         dp_world_size=1,
     )
     assert cfg.fp16.enabled
+
+
+def test_checkpoint_block_keep_n_and_verify():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "checkpoint": {"keep_n": 3, "verify": False}},
+        dp_world_size=1,
+    )
+    assert cfg.checkpoint_keep_n == 3
+    assert cfg.checkpoint_verify is False
+    # defaults: keep everything, verify manifests
+    dflt = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=1)
+    assert dflt.checkpoint_keep_n == 0
+    assert dflt.checkpoint_verify is True
+
+
+def test_checkpoint_negative_keep_n_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {"train_batch_size": 8, "checkpoint": {"keep_n": -1}},
+            dp_world_size=1,
+        )
+
+
+def test_graceful_shutdown_block():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "graceful_shutdown": {
+                "enabled": True,
+                "save_dir": "/tmp/ckpt",
+                "signals": ["SIGTERM"],
+                "exit_after_save": False,
+                "exit_code": 42,
+            },
+        },
+        dp_world_size=1,
+    )
+    gs = cfg.graceful_shutdown
+    assert gs.enabled and gs.save_dir == "/tmp/ckpt"
+    assert gs.signals == ["SIGTERM"]
+    assert gs.exit_after_save is False and gs.exit_code == 42
+    # default: disabled, both preemption signals handled
+    dflt = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=1)
+    assert dflt.graceful_shutdown.enabled is False
+    assert dflt.graceful_shutdown.signals == ["SIGTERM", "SIGINT"]
+
+
+def test_graceful_shutdown_enabled_requires_save_dir():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {"train_batch_size": 8, "graceful_shutdown": {"enabled": True}},
+            dp_world_size=1,
+        )
+
+
+def test_graceful_shutdown_unknown_signal_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {
+                "train_batch_size": 8,
+                "graceful_shutdown": {
+                    "enabled": True,
+                    "save_dir": "/tmp/ckpt",
+                    "signals": ["SIGQUACK"],
+                },
+            },
+            dp_world_size=1,
+        )

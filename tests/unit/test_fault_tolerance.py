@@ -1,0 +1,442 @@
+"""Fault-tolerance layer tests: checkpoint integrity manifests, durable
+writes + transient-IO retry, torn-write fallback, preemption grace saves,
+retention GC, elastic-agent crash-loop hygiene, and the fault-injection
+harness itself (docs/recovery.md). Run standalone via ``make chaos``."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime import checkpoint_manifest as cm
+from deepspeed_tpu.runtime.checkpoint_engine import (
+    AsyncCheckpointEngine,
+    MsgpackCheckpointEngine,
+)
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+from deepspeed_tpu.utils import fault_injection as fi
+
+from unit.simple_model import SimpleModel, random_dataset
+
+
+@pytest.fixture(autouse=True)
+def _fast_io_retries(monkeypatch):
+    """Exponential backoff with zero base so injected transient failures
+    retry instantly (the policy, not the wall clock, is under test)."""
+    monkeypatch.setattr(cm, "IO_BACKOFF_S", 0.0)
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def make_engine(config=None):
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=8), config=config or base_config(),
+        training_data=random_dataset(64),
+    )
+    return engine, iter(RepeatingLoader(loader))
+
+
+# ---------------------------------------------------------------------------
+# durable atomic writes + manifest primitives
+# ---------------------------------------------------------------------------
+def test_atomic_write_bytes_durable_and_clean(tmp_path):
+    path = str(tmp_path / "sub" / "blob.bin")
+    failures = cm.atomic_write_bytes(path, b"payload")
+    assert failures == 0
+    assert open(path, "rb").read() == b"payload"
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_atomic_write_retries_transient_failure(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    with fi.failing_writes(match="blob.bin", fail_times=2) as inj:
+        failures = cm.atomic_write_bytes(path, b"x" * 64)
+    assert inj.injected == 2
+    assert failures == 2
+    assert os.path.getsize(path) == 64
+
+
+def test_atomic_write_gives_up_after_retry_budget(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    with fi.failing_writes(match="blob.bin"):  # permanent
+        with pytest.raises(OSError, match="injected"):
+            cm.atomic_write_bytes(path, b"x")
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_manifest_verify_detects_truncation_and_missing(tmp_path):
+    tag_dir = str(tmp_path / "t1")
+    cm.atomic_write_bytes(os.path.join(tag_dir, "a.bin"), b"a" * 100)
+    cm.atomic_write_bytes(os.path.join(tag_dir, "b.bin"), b"b" * 50)
+    cm.write_manifest(tag_dir, "t1", {
+        "a.bin": cm.file_digest(os.path.join(tag_dir, "a.bin")),
+        "b.bin": cm.file_digest(os.path.join(tag_dir, "b.bin")),
+    })
+    assert cm.verify_tag_dir(tag_dir) == []
+
+    fi.truncate_file(os.path.join(tag_dir, "a.bin"), keep_fraction=0.5)
+    problems = cm.verify_tag_dir(tag_dir)
+    assert len(problems) == 1 and "size mismatch" in problems[0]
+
+    os.unlink(os.path.join(tag_dir, "a.bin"))
+    assert any("missing file" in p for p in cm.verify_tag_dir(tag_dir))
+    # a dir with no manifest is unverifiable, not invalid
+    assert cm.verify_tag_dir(str(tmp_path / "nothing")) is None
+
+
+def test_manifest_verify_detects_bitflip_same_size(tmp_path):
+    tag_dir = str(tmp_path / "t1")
+    path = os.path.join(tag_dir, "a.bin")
+    cm.atomic_write_bytes(path, b"a" * 100)
+    cm.write_manifest(tag_dir, "t1", {"a.bin": cm.file_digest(path)})
+    with open(path, "r+b") as f:
+        f.seek(10)
+        f.write(b"Z")
+    problems = cm.verify_tag_dir(tag_dir)
+    assert len(problems) == 1 and "crc mismatch" in problems[0]
+
+
+def test_find_valid_tags_newest_first_and_excludes(tmp_path):
+    for i, name in enumerate(["t1", "t2", "t3"]):
+        tag_dir = str(tmp_path / name)
+        path = os.path.join(tag_dir, "w.bin")
+        cm.atomic_write_bytes(path, name.encode())
+        cm.write_manifest(tag_dir, name, {"w.bin": cm.file_digest(path)})
+        # force distinct, ordered manifest mtimes
+        t = 1_000_000 + i
+        os.utime(cm.manifest_path(tag_dir), (t, t))
+    fi.truncate_file(str(tmp_path / "t3" / "w.bin"), keep_bytes=0)
+    assert cm.find_valid_tags(str(tmp_path)) == ["t2", "t1"]
+    assert cm.latest_valid_tag(str(tmp_path), exclude={"t2"}) == "t1"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint engines
+# ---------------------------------------------------------------------------
+def test_msgpack_engine_commit_writes_manifest(tmp_path):
+    eng = MsgpackCheckpointEngine()
+    tag_dir = str(tmp_path / "tagA")
+    eng.save({"w": np.arange(8, dtype=np.float32)},
+             os.path.join(tag_dir, "model.msgpack"))
+    eng.save({"m": np.zeros(4, dtype=np.float32)},
+             os.path.join(tag_dir, "optim.msgpack"))
+    assert not os.path.exists(cm.manifest_path(tag_dir))  # pre-commit
+    eng.commit("tagA")
+    manifest = cm.read_manifest(tag_dir)
+    assert set(manifest["files"]) == {"model.msgpack", "optim.msgpack"}
+    assert cm.verify_tag_dir(tag_dir) == []
+
+
+def test_async_engine_two_failed_writes_report_both(tmp_path, monkeypatch):
+    """Regression (ISSUE 2 satellite): save() must keep snapshotting and
+    enqueuing after an earlier write failed, and commit() must surface
+    EVERY accumulated failure, not just the first."""
+    monkeypatch.setattr(cm, "IO_RETRIES", 0)
+    eng = AsyncCheckpointEngine()
+    p1 = str(tmp_path / "tagA" / "one.msgpack")
+    p2 = str(tmp_path / "tagA" / "two.msgpack")
+    with fi.failing_writes(match=str(tmp_path)) as inj:
+        eng.save({"a": np.ones(2, np.float32)}, p1)
+        eng.save({"b": np.ones(2, np.float32)}, p2)  # enqueued regardless
+        with pytest.raises(RuntimeError) as ei:
+            eng.commit("tagA")
+    assert inj.injected == 2
+    msg = str(ei.value)
+    assert "2 file(s)" in msg and p1 in msg and p2 in msg
+    # the failed tag must not have been certified
+    assert not os.path.exists(cm.manifest_path(str(tmp_path / "tagA")))
+
+    # the engine stays usable: a later save + commit succeeds cleanly
+    p3 = str(tmp_path / "tagB" / "three.msgpack")
+    eng.save({"c": np.ones(2, np.float32)}, p3)
+    assert eng.commit("tagB")
+    assert cm.verify_tag_dir(str(tmp_path / "tagB")) == []
+
+
+# ---------------------------------------------------------------------------
+# engine-level recovery
+# ---------------------------------------------------------------------------
+def test_truncated_newest_tag_falls_back_to_previous(eight_devices,
+                                                     tmp_path):
+    """Acceptance: a deliberately truncated model-states file in the
+    newest tag loads from the previous valid tag instead of crashing."""
+    engine, it = make_engine()
+    engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path))
+    good_steps = engine.global_steps
+    good_params = [np.asarray(x) for x in engine.params_leaves()] \
+        if hasattr(engine, "params_leaves") else None
+    engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path))
+    bad_tag = f"global_step{engine.global_steps}"
+    # keep manifest mtimes strictly ordered regardless of fs resolution
+    old_manifest = cm.manifest_path(
+        str(tmp_path / f"global_step{good_steps}"))
+    os.utime(old_manifest, (os.path.getmtime(old_manifest) - 10,) * 2)
+
+    fi.truncate_file(
+        str(tmp_path / bad_tag / "mp_rank_00_model_states.msgpack"),
+        keep_fraction=0.5)
+    tag, _ = engine.load_checkpoint(str(tmp_path))
+    assert tag == f"global_step{good_steps}"
+    assert engine.global_steps == good_steps
+    assert engine.ft_stats["ckpt_fallbacks"] == 1
+    # and training continues from the restored state
+    engine.train_batch(it)
+    assert engine.global_steps == good_steps + 1
+
+
+def test_corrupt_tag_without_fallback_raises(eight_devices, tmp_path):
+    engine, it = make_engine()
+    engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path))
+    tag = f"global_step{engine.global_steps}"
+    fi.truncate_file(
+        str(tmp_path / tag / "mp_rank_00_model_states.msgpack"),
+        keep_fraction=0.3)
+    with pytest.raises(RuntimeError, match="no previous valid tag"):
+        engine.load_checkpoint(str(tmp_path))
+
+
+def test_transient_write_failure_save_retries_and_succeeds(eight_devices,
+                                                           tmp_path):
+    engine, it = make_engine()
+    engine.train_batch(it)
+    with fi.failing_writes(match="model_states", fail_times=1) as inj:
+        engine.save_checkpoint(str(tmp_path))
+    assert inj.injected == 1
+    assert engine.checkpoint_engine.io_retry_count >= 1
+    tag = f"global_step{engine.global_steps}"
+    assert cm.verify_tag_dir(str(tmp_path / tag)) == []
+    assert engine.load_checkpoint(str(tmp_path))[0] == tag
+
+
+def test_sigterm_grace_save_then_resume_same_step(eight_devices, tmp_path):
+    """Acceptance: SIGTERM mid-training produces a committed, manifest-
+    valid checkpoint from which training resumes at the same
+    global_steps."""
+    ckpt_dir = tmp_path / "preempt_ckpt"
+    cfg = base_config(
+        graceful_shutdown={"enabled": True, "save_dir": str(ckpt_dir)})
+    old_term = signal.getsignal(signal.SIGTERM)
+    try:
+        engine, it = make_engine(cfg)
+        engine.train_batch(it)
+        engine.train_batch(it)
+        os.kill(os.getpid(), signal.SIGTERM)  # handler only sets a flag
+        with pytest.raises(SystemExit) as ei:
+            engine.train_batch(it)  # grace save fires at the boundary
+        assert ei.value.code == 0
+        steps_at_exit = engine.global_steps
+        assert engine.ft_stats["graceful_shutdowns"] == 1
+        tag = cm.read_latest(str(ckpt_dir))
+        assert tag == f"global_step{steps_at_exit}"
+        assert cm.verify_tag_dir(str(ckpt_dir / tag)) == []
+        # handlers are restored so a second signal would kill normally
+        assert signal.getsignal(signal.SIGTERM) == old_term
+
+        resumed, it2 = make_engine()  # plain config: no handler games
+        resumed.train_batch(it2)  # init state templates
+        got_tag, _ = resumed.load_checkpoint(str(ckpt_dir))
+        assert got_tag == tag
+        assert resumed.global_steps == steps_at_exit
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def test_retention_keep_n_never_deletes_latest(eight_devices, tmp_path):
+    cfg = base_config(checkpoint={"keep_n": 2})
+    engine, it = make_engine(cfg)
+    tags = []
+    for i in range(3):
+        engine.train_batch(it)
+        engine.save_checkpoint(str(tmp_path))
+        tag = f"global_step{engine.global_steps}"
+        tags.append(tag)
+        mpath = cm.manifest_path(str(tmp_path / tag))
+        t = 1_000_000 + i  # strictly ordered manifest mtimes
+        os.utime(mpath, (t, t))
+    engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path))
+    tags.append(f"global_step{engine.global_steps}")
+
+    remaining = sorted(d for d in os.listdir(tmp_path)
+                       if (tmp_path / d).is_dir())
+    assert remaining == sorted(tags[-2:])
+    assert cm.read_latest(str(tmp_path)) == tags[-1]
+    assert not os.path.exists(tmp_path / "latest.tmp")
+
+
+def test_ft_counters_exported_through_monitor(eight_devices, tmp_path):
+    cfg = base_config(csv_monitor={"enabled": True,
+                                   "output_path": str(tmp_path / "logs"),
+                                   "job_name": "ft"})
+    engine, it = make_engine(cfg)
+    engine.train_batch(it)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    engine.load_checkpoint(str(tmp_path / "ckpt"))
+    log_dir = tmp_path / "logs" / "ft"
+    saves = (log_dir / "FaultTolerance_ckpt_saves.csv").read_text()
+    loads = (log_dir / "FaultTolerance_ckpt_loads.csv").read_text()
+    assert saves.strip().splitlines()[-1].endswith("1.0")
+    assert loads.strip().splitlines()[-1].endswith("1.0")
+
+
+# ---------------------------------------------------------------------------
+# elastic agent hardening
+# ---------------------------------------------------------------------------
+def _write_worker(tmp_path, body) -> str:
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(body))
+    return str(worker)
+
+
+def test_elastic_agent_crash_loop_detection(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import (
+        CrashLoopError, DSElasticAgent)
+
+    worker = _write_worker(tmp_path, "import sys; sys.exit(13)")
+    agent = DSElasticAgent([sys.executable, worker], {},
+                           discover_world=lambda: 1, max_restarts=10,
+                           backoff_s=0.0, jitter=0.0,
+                           crash_loop_window_s=60.0, crash_loop_threshold=3)
+    with pytest.raises(CrashLoopError, match="crash loop detected"):
+        agent.run()
+    # aborted at the threshold, not after the whole restart budget
+    assert agent.restart_count == 2
+
+
+def test_elastic_agent_stable_window_resets_budget(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    marker = tmp_path / "attempts"
+    worker = _write_worker(tmp_path, f"""
+        import os, sys
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        sys.exit(0 if n >= 3 else 7)  # fail three times, then succeed
+    """)
+    # max_restarts=1 would exhaust after the second failure, but every
+    # run clears the 0-second stable window and refills the budget
+    agent = DSElasticAgent([sys.executable, worker], {},
+                           discover_world=lambda: 1, max_restarts=1,
+                           backoff_s=0.0, jitter=0.0, stable_window_s=0.0)
+    assert agent.run() == 0
+    assert marker.read_text() == "4"
+
+
+def test_elastic_agent_exponential_backoff_with_cap(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    worker = _write_worker(tmp_path, "import sys; sys.exit(5)")
+    agent = DSElasticAgent([sys.executable, worker], {},
+                           discover_world=lambda: 1, max_restarts=4,
+                           backoff_s=1.0, max_backoff_s=4.0, jitter=0.0)
+    delays = []
+    agent._sleep = delays.append
+    assert agent.run() == 5
+    assert delays == [1.0, 2.0, 4.0, 4.0]
+
+
+def test_elastic_agent_propagates_last_valid_tag(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    ckpt = tmp_path / "ckpt"
+    for i, tag in enumerate(["global_step1", "global_step2"]):
+        tag_dir = str(ckpt / tag)
+        path = os.path.join(tag_dir, "model.msgpack")
+        cm.atomic_write_bytes(path, b"weights" * 10)
+        cm.write_manifest(tag_dir, tag, {"model.msgpack":
+                                         cm.file_digest(path)})
+        t = 1_000_000 + i
+        os.utime(cm.manifest_path(tag_dir), (t, t))
+    cm.write_latest(str(ckpt), "global_step2")
+    # the newest tag is torn: its manifest no longer verifies
+    fi.truncate_file(str(ckpt / "global_step2" / "model.msgpack"),
+                     keep_bytes=3)
+
+    out = tmp_path / "seen_env.txt"
+    worker = _write_worker(tmp_path, f"""
+        import os
+        open({str(out)!r}, "w").write(
+            os.environ.get("DS_TPU_LAST_VALID_TAG", "<unset>"))
+    """)
+    agent = DSElasticAgent([sys.executable, worker], {},
+                           discover_world=lambda: 1, ckpt_dir=str(ckpt))
+    assert agent.run() == 0
+    assert out.read_text() == "global_step1"
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness semantics
+# ---------------------------------------------------------------------------
+def test_failing_writes_only_touches_write_modes(tmp_path):
+    # NOTE: plain builtins open() throughout — pathlib binds io.open at
+    # import time and sidesteps the patch, as would any direct io.open
+    victim = str(tmp_path / "victim.txt")
+    with open(victim, "w") as f:
+        f.write("before")
+    other = str(tmp_path / "other.txt")
+    with fi.failing_writes(match="victim") as inj:
+        assert open(victim).read() == "before"  # reads untouched
+        with open(other, "w") as f:             # non-matching writes pass
+            f.write("fine")
+        with pytest.raises(OSError, match="injected"):
+            open(victim, "w")
+    assert inj.injected == 1
+    assert open(victim).read() == "before"
+    with open(victim, "w") as f:  # patch fully unwound
+        f.write("after")
+    assert open(victim).read() == "after"
+
+
+def test_torn_writes_rename_lands_with_truncated_content(tmp_path):
+    path = str(tmp_path / "target.bin")
+    with fi.torn_writes(match="target.bin", keep_fraction=0.5) as inj:
+        cm.atomic_write_bytes(path, b"x" * 100)
+    assert inj.injected == 1
+    # the write "succeeded" but the content is torn — exactly the state
+    # manifest verification exists to catch
+    assert os.path.getsize(path) == 50
+
+
+def test_kill_at_step_delivers_signal_to_child(tmp_path):
+    step_file = str(tmp_path / "step")
+    marker = str(tmp_path / "killed_at")
+    child = _write_worker(tmp_path, f"""
+        import signal, sys, time
+        step_file, marker = {step_file!r}, {marker!r}
+
+        def handler(signum, frame):
+            open(marker, "w").write(open(step_file).read())
+            sys.exit(0)
+
+        signal.signal(signal.SIGTERM, handler)
+        for i in range(2000):
+            open(step_file, "w").write(str(i))
+            time.sleep(0.005)
+        sys.exit(1)  # never got preempted: the test failed
+    """)
+    proc = subprocess.Popen([sys.executable, child])
+    with fi.kill_at_step(proc, step_file, step=10) as inj:
+        rc = proc.wait(timeout=60)
+    assert rc == 0
+    assert inj.injected == 1
+    assert int(open(marker).read()) >= 10
