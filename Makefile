@@ -8,8 +8,9 @@
 #   make smoke   perf regression gate on the real chip
 #                (benchmarks/smoke.py vs committed expected.json, +-10%)
 #   make chaos   fault-injection suite: torn/failed checkpoint writes,
-#                preemption grace saves, crash-loop detection
-#                (docs/recovery.md)
+#                preemption grace saves, crash-loop detection, and the
+#                training health sentinel: NaN/spike anomalies, auto-
+#                rollback, hang watchdog (docs/recovery.md)
 #   make check   test + smoke-if-hot-paths-changed — the full gate
 #   make hooks   install the committed .githooks (pre-push runs
 #                `make quick` + conditional smoke)
@@ -35,7 +36,7 @@ smoke:
 	$(PY) benchmarks/smoke.py
 
 chaos:
-	$(PY) -m pytest tests/unit/test_fault_tolerance.py -q
+	$(PY) -m pytest tests/unit/test_fault_tolerance.py tests/unit/test_sentinel.py -q
 
 # exits 0 when any hot-path file differs from BASE (override: `make
 # hot-changed BASE=<sha>` — the pre-push hook passes the remote sha so a

@@ -12,6 +12,7 @@ from deepspeed_tpu.version import __version__  # noqa: F401
 
 from deepspeed_tpu import comm  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
+from deepspeed_tpu.runtime.sentinel import DivergenceError  # noqa: F401
 from deepspeed_tpu.parallel.mesh import (  # noqa: F401
     MeshTopology,
     get_default_topology,

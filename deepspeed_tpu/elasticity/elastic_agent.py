@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional
 
 from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
 from deepspeed_tpu.runtime import checkpoint_manifest
+from deepspeed_tpu.runtime import constants as ds_constants
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -80,6 +81,13 @@ class DSElasticAgent:
         checkpoint root; on every (re)launch the newest manifest-valid
         tag is exported as ``DS_TPU_LAST_VALID_TAG`` so the worker can
         recover even when the newest tag / 'latest' pointer is torn.
+    divergence_exit_codes:
+        exit codes that mean "training diverged past its rollback
+        budget" (the sentinel's ``DivergenceError`` code, default 13) —
+        restarting from the same checkpoint/data would replay the same
+        divergence, so the agent returns immediately instead of burning
+        the restart budget on it. A crash (any other non-zero code,
+        including the hang watchdog's abort) stays restartable.
     """
 
     def __init__(self, cmd: List[str], ds_config: Dict,
@@ -90,6 +98,8 @@ class DSElasticAgent:
                  crash_loop_window_s: Optional[float] = None,
                  crash_loop_threshold: int = 3,
                  ckpt_dir: Optional[str] = None,
+                 divergence_exit_codes=(
+                     ds_constants.DIVERGENCE_EXIT_CODE_DEFAULT,),
                  env: Optional[Dict[str, str]] = None):
         self.cmd = list(cmd)
         self.ds_config = ds_config
@@ -103,6 +113,8 @@ class DSElasticAgent:
         self.crash_loop_window_s = crash_loop_window_s
         self.crash_loop_threshold = crash_loop_threshold
         self.ckpt_dir = ckpt_dir
+        self.divergence_exit_codes = frozenset(
+            int(c) for c in (divergence_exit_codes or ()))
         self.env = dict(env if env is not None else os.environ)
         self.restart_count = 0
         self._failure_times: List[float] = []
@@ -197,6 +209,16 @@ class DSElasticAgent:
                 return 1
             if rc == 0:
                 return 0
+            if rc in self.divergence_exit_codes:
+                logger.error(
+                    f"worker exited with divergence code {rc}: training "
+                    f"diverged past its rollback budget, and restarting "
+                    f"from the same state would replay the same "
+                    f"divergence — not restarting. Inspect the run "
+                    f"(lr/data/precision)"
+                    + (f" and the checkpoint dir ({self.ckpt_dir})"
+                       if self.ckpt_dir else "") + ".")
+                return rc
             now = time.monotonic()
             run_s = now - started
             self._failure_times.append(now)
@@ -246,6 +268,12 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt_dir", default=None,
                    help="checkpoint root; the newest manifest-valid tag "
                         "is exported to workers as DS_TPU_LAST_VALID_TAG")
+    p.add_argument("--divergence_exit_code", type=int, action="append",
+                   default=None,
+                   help="worker exit code meaning 'training diverged' — "
+                        "the agent returns instead of restarting into "
+                        "the same divergence (repeatable; default "
+                        f"{ds_constants.DIVERGENCE_EXIT_CODE_DEFAULT})")
     p.add_argument("cmd", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
@@ -261,7 +289,10 @@ def main(argv=None) -> int:
         stable_window_s=args.stable_window,
         crash_loop_window_s=args.crash_loop_window,
         crash_loop_threshold=args.crash_loop_threshold,
-        ckpt_dir=args.ckpt_dir)
+        ckpt_dir=args.ckpt_dir,
+        divergence_exit_codes=(
+            args.divergence_exit_code if args.divergence_exit_code
+            else (ds_constants.DIVERGENCE_EXIT_CODE_DEFAULT,)))
     return agent.run()
 
 

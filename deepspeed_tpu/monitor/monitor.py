@@ -37,6 +37,9 @@ class Monitor:
     def write_events(self, event_list: List[Event]):
         raise NotImplementedError
 
+    def close(self):
+        """Flush and release backend resources (idempotent)."""
+
 
 class TensorBoardMonitor(Monitor):
     """reference monitor/tensorboard.py — needs tensorboardX or torch tb."""
@@ -66,6 +69,11 @@ class TensorBoardMonitor(Monitor):
             self.summary_writer.add_scalar(tag, value, step)
         self.summary_writer.flush()
 
+    def close(self):
+        if self.summary_writer is not None:
+            self.summary_writer.close()
+            self.summary_writer = None
+
 
 class WandbMonitor(Monitor):
     """reference monitor/wandb.py."""
@@ -90,6 +98,11 @@ class WandbMonitor(Monitor):
         for tag, value, step in event_list:
             self.wandb.log({tag: value}, step=step)
 
+    def close(self):
+        if self.wandb is not None:
+            self.wandb.finish()
+            self.wandb = None
+
 
 class CsvMonitor(Monitor):
     """reference monitor/csv_monitor.py — one csv file per event tag."""
@@ -112,17 +125,24 @@ class CsvMonitor(Monitor):
     def write_events(self, event_list: List[Event]):
         if self.log_dir is None:
             return
+        # one open per tag per batch, not per event: a per-step counter
+        # export is a dozen events over a handful of tags, and open/close
+        # per row is the dominant cost on networked filesystems
+        by_tag = {}
         for tag, value, step in event_list:
+            by_tag.setdefault(tag, []).append((step, value))
+        for tag, rows in by_tag.items():
             path = self._path(tag)
             new = not os.path.exists(path)
             with open(path, "a", newline="") as f:
                 w = csv.writer(f)
                 if new:
                     w.writerow(["step", tag])
-                w.writerow([step, value])
+                w.writerows(rows)
 
     def close(self):
-        pass
+        # nothing held open between batches; disable further writes
+        self.log_dir = None
 
 
 class MonitorMaster(Monitor):
@@ -154,3 +174,14 @@ class MonitorMaster(Monitor):
         for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
             if m is not None:
                 m.write_events(event_list)
+
+    def close(self):
+        """Flush/close every backend (graceful-shutdown path). Idempotent;
+        later ``write_events`` calls become no-ops."""
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m is not None:
+                try:
+                    m.close()
+                except Exception as e:  # closing must never mask shutdown
+                    logger.warning("monitor close failed: %s", e)
+        self.enabled = False

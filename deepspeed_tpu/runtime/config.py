@@ -288,6 +288,63 @@ class GracefulShutdownConfig(ConfigModel):
 
 
 @dataclass
+class SentinelConfig(ConfigModel):
+    """Training health sentinel (no reference analogue; docs/recovery.md
+    "Divergence and hang recovery"). When enabled, the engine judges every
+    optimizer step host-side — non-finite loss/grads (any dtype, not just
+    the fp16 loss-scale path) plus rolling-window loss/grad-norm spike
+    detection — and responds in graduated stages: cond-skip the bad batch
+    (``skip_budget`` consecutive), roll back to the newest manifest-valid
+    checkpoint (``rollback_budget`` times, reseeding the data order), then
+    raise ``DivergenceError`` with ``divergence_exit_code``. A daemon
+    hang watchdog arms around each step when ``hang_timeout_s > 0``."""
+
+    enabled: bool = False
+    check_nonfinite: bool = True
+    window: int = 50            # rolling-window length (healthy steps)
+    min_window: int = 10        # samples required before spike checks arm
+    loss_spike_zscore: float = 6.0   # <=0 disables the z-score check
+    loss_spike_ratio: float = 3.0    # <=0 disables the ratio check
+    grad_spike_zscore: float = 6.0
+    grad_spike_ratio: float = 10.0
+    skip_budget: int = 3        # consecutive anomalies before rollback
+    rollback_budget: int = 2    # rollbacks before DivergenceError
+    rollback_dir: Optional[str] = None  # checkpoint root to roll back to
+    reseed_on_rollback: bool = True
+    divergence_exit_code: int = C.DIVERGENCE_EXIT_CODE_DEFAULT
+    hang_timeout_s: float = 0.0  # 0 disables the watchdog
+    hang_action: str = "warn"    # warn | abort
+    hang_exit_code: int = C.SENTINEL_HANG_EXIT_CODE_DEFAULT
+
+    def __post_init__validate__(self):
+        if self.window < 2:
+            raise DeepSpeedConfigError(
+                f"sentinel.window must be >= 2, got {self.window}")
+        if not (2 <= self.min_window <= self.window):
+            raise DeepSpeedConfigError(
+                f"sentinel.min_window must be in [2, window="
+                f"{self.window}], got {self.min_window}")
+        if self.skip_budget < 0 or self.rollback_budget < 0:
+            raise DeepSpeedConfigError(
+                "sentinel.skip_budget and sentinel.rollback_budget must "
+                "be >= 0")
+        if self.hang_timeout_s < 0:
+            raise DeepSpeedConfigError(
+                f"sentinel.hang_timeout_s must be >= 0 (0 disables), got "
+                f"{self.hang_timeout_s}")
+        if self.hang_action not in ("warn", "abort"):
+            raise DeepSpeedConfigError(
+                f"sentinel.hang_action must be 'warn' or 'abort', got "
+                f"{self.hang_action!r}")
+        for name in ("divergence_exit_code", "hang_exit_code"):
+            code = getattr(self, name)
+            if not (1 <= int(code) <= 255):
+                raise DeepSpeedConfigError(
+                    f"sentinel.{name} must be in [1, 255] (0 means "
+                    f"success to the elastic agent), got {code}")
+
+
+@dataclass
 class MeshConfig(ConfigModel):
     """TPU device-mesh axis sizes. -1 on ``dp`` means "use all remaining
     devices". No reference analogue: replaces mpu/process-group plumbing
@@ -458,6 +515,7 @@ class DeepSpeedConfig:
             C.CHECKPOINT_VERIFY, C.CHECKPOINT_VERIFY_DEFAULT))
         self.graceful_shutdown = GracefulShutdownConfig.from_dict(
             pd.get(C.GRACEFUL_SHUTDOWN, {}))
+        self.sentinel = SentinelConfig.from_dict(pd.get(C.SENTINEL, {}))
 
         if self.dp_world_size is not None:
             self._resolve_batch_triad(self.dp_world_size)

@@ -127,6 +127,20 @@ CHECKPOINT_VERIFY_DEFAULT = True
 # grace handler that saves + commits a final checkpoint before exit.
 GRACEFUL_SHUTDOWN = "graceful_shutdown"
 
+# Training health sentinel block (docs/recovery.md "Divergence and hang
+# recovery"): anomaly detection + graduated skip/rollback response + hang
+# watchdog. The exit codes live here (jax-free module) so the elastic
+# agent and worker scripts can share them without importing the runtime.
+SENTINEL = "sentinel"
+SENTINEL_ENABLED = "enabled"
+SENTINEL_ENABLED_DEFAULT = False
+# distinct from any shell/signal convention: "diverged, restarting will
+# replay the same failure" vs "crashed, restart is the fix"
+DIVERGENCE_EXIT_CODE_DEFAULT = 13
+# the hang-watchdog abort code: a hang IS worth restarting (transient
+# wedged collective), so it must differ from the divergence code
+SENTINEL_HANG_EXIT_CODE_DEFAULT = 14
+
 DATALOADER_DROP_LAST = "dataloader_drop_last"
 DATALOADER_DROP_LAST_DEFAULT = False
 

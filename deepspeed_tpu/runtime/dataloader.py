@@ -44,9 +44,14 @@ class DeepSpeedDataLoader:
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
+        self._base_seed = seed
         self.drop_last = drop_last
         self.collate_fn = collate_fn or default_collate
         self.epoch = 0
+        # bumped whenever (seed, epoch) changes out-of-band (reseed or
+        # load_state_dict): RepeatingLoader watches it to restart its
+        # iterator so the new order takes effect mid-epoch
+        self.order_version = 0
         if drop_last:
             self.num_batches = len(dataset) // batch_size
         else:
@@ -59,6 +64,24 @@ class DeepSpeedDataLoader:
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+
+    def reseed(self, offset: int):
+        """Derive a fresh shuffle order (seed = base seed + offset) — the
+        sentinel's rollback re-entry path: replaying the exact batch
+        sequence that diverged once would diverge again."""
+        self.seed = self._base_seed + int(offset)
+        self.order_version += 1
+
+    def state_dict(self):
+        """Data-order state carried through engine checkpoints so
+        rollback/resume restores the order instead of restarting the
+        epoch."""
+        return {"epoch": self.epoch, "seed": self.seed}
+
+    def load_state_dict(self, state):
+        self.epoch = int(state.get("epoch", self.epoch))
+        self.seed = int(state.get("seed", self.seed))
+        self.order_version += 1
 
     def __len__(self):
         return self.num_batches
@@ -81,11 +104,18 @@ class RepeatingLoader:
     def __init__(self, loader: Iterable):
         self.loader = loader
         self.data_iter = iter(self.loader)
+        self._order_version = getattr(loader, "order_version", None)
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        inner_version = getattr(self.loader, "order_version", None)
+        if inner_version != self._order_version:
+            # the wrapped loader was reseeded/restored out-of-band: the
+            # in-flight iterator still walks the OLD order — restart it
+            self._order_version = inner_version
+            self.data_iter = iter(self.loader)
         try:
             return next(self.data_iter)
         except StopIteration:
@@ -93,3 +123,12 @@ class RepeatingLoader:
                 self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
+
+    def state_dict(self):
+        if hasattr(self.loader, "state_dict"):
+            return self.loader.state_dict()
+        return {}
+
+    def load_state_dict(self, state):
+        if hasattr(self.loader, "load_state_dict"):
+            self.loader.load_state_dict(state)

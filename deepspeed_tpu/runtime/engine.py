@@ -403,6 +403,37 @@ class DeepSpeedEngine:
         if config.graceful_shutdown.enabled:
             self._install_signal_handlers()
 
+        # training health sentinel (config-gated; docs/recovery.md
+        # "Divergence and hang recovery"): anomaly verdicts per optimizer
+        # step, graduated skip→rollback→DivergenceError response, and a
+        # daemon hang watchdog armed around each dispatched step.
+        # _check_overflow widens the in-graph lax.cond overflow gate from
+        # fp16-only to any precision; when it is False the step never
+        # pulls the overflow scalar to host (the bf16 no-sync fast path).
+        self.sentinel = None
+        self._watchdog = None
+        self._nonfinite_guard = False
+        self._check_overflow = self.fp16_enabled
+        self._sentinel_emitted = None
+        self.training_dataloader = None
+        if config.sentinel.enabled:
+            from deepspeed_tpu.runtime.sentinel import (
+                HangWatchdog,
+                TrainingSentinel,
+            )
+
+            self.sentinel = TrainingSentinel(config.sentinel)
+            self._nonfinite_guard = bool(config.sentinel.check_nonfinite)
+            self._check_overflow = (self.fp16_enabled
+                                    or self._nonfinite_guard)
+            if config.sentinel.hang_timeout_s > 0:
+                self._watchdog = HangWatchdog(
+                    timeout_s=config.sentinel.hang_timeout_s,
+                    action=config.sentinel.hang_action,
+                    exit_code=config.sentinel.hang_exit_code,
+                    on_fire=self.sentinel.note_watchdog_fire)
+                self._watchdog.start()
+
         # module-level activation checkpointing (reference engine.py:818
         # _configure_checkpointing): models that call
         # activation_checkpointing.checkpoint() pick up this policy
@@ -930,7 +961,7 @@ class DeepSpeedEngine:
         update are cond-skipped with the error-feedback buffers and the
         optimizer count untouched (reference fp16+onebit skip semantics,
         fp16/onebit/adam.py:10)."""
-        overflow = (has_overflow(grads) if self.fp16_enabled
+        overflow = (has_overflow(grads) if self._check_overflow
                     else jnp.bool_(False))
 
         def do_update(operand):
@@ -1050,12 +1081,16 @@ class DeepSpeedEngine:
             return self._build_apply_compressed()
         tx = self._tx
         clip = self.gradient_clipping
-        check_fp16 = self.fp16_enabled
+        # fp16 loss-scale gating, or the sentinel's any-dtype non-finite
+        # guard: a NaN/Inf grad tree cond-skips the update either way
+        # (update_loss_scale is a no-op when fp16 dynamic scaling is off)
+        check_overflow = self._check_overflow
         ls_config = self._ls_config
 
         def apply_step(params, opt_state, acc_grads, ls_state, lr_factor):
             grads = jax.tree.map(lambda g: g / ls_state.scale, acc_grads)
-            overflow = has_overflow(grads) if check_fp16 else jnp.bool_(False)
+            overflow = (has_overflow(grads) if check_overflow
+                        else jnp.bool_(False))
             grad_norm = optax.global_norm(grads)
             if clip and clip > 0:
                 factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
@@ -1106,7 +1141,7 @@ class DeepSpeedEngine:
         model = self.module
         tx = self._tx
         clip = self.gradient_clipping
-        check_fp16 = self.fp16_enabled
+        check_overflow = self._check_overflow  # see _build_apply
         ls_config = self._ls_config
 
         def train_step(params, opt_state, ls_state, batch, rng, step,
@@ -1125,7 +1160,7 @@ class DeepSpeedEngine:
             grads, loss = jax.grad(loss_fn, has_aux=True)(params)
             grads = jax.tree.map(
                 lambda g: g.astype(jnp.float32) / ls_state.scale, grads)
-            overflow = has_overflow(grads) if check_fp16 \
+            overflow = has_overflow(grads) if check_overflow \
                 else jnp.bool_(False)
             grad_norm = optax.global_norm(grads)
             if clip and clip > 0:
@@ -1178,13 +1213,18 @@ class DeepSpeedEngine:
         global_micro = (
             self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
         )
-        return DeepSpeedDataLoader(
+        loader = DeepSpeedDataLoader(
             dataset,
             batch_size=global_micro,
             shuffle=shuffle,
             drop_last=self._config.dataloader_drop_last or True,
             collate_fn=collate_fn,
         )
+        # the engine keeps the training loader: checkpoints carry its
+        # (epoch, seed) state, and the sentinel reseeds it on rollback so
+        # re-entry doesn't replay the exact batch sequence that diverged
+        self.training_dataloader = loader
+        return loader
 
     def _put_batch(self, batch: Dict[str, Any]):
         sharding = self.topology.batch_sharding()
@@ -1222,8 +1262,16 @@ class DeepSpeedEngine:
             batch = self._apply_curriculum(batch)
         if not self._initialized:
             self._init_state(batch)
-        if self._fwd_bwd_fn is None:
+        compile_pending = self._fwd_bwd_fn is None
+        if compile_pending:
             self._fwd_bwd_fn = self._build_fwd_bwd()
+        # heartbeat: every micro step re-arms; the step boundary disarms.
+        # The first call compiles (minutes, legitimately) — the watchdog
+        # cannot tell that from a hang, so it stays disarmed around it;
+        # size hang_timeout_s above any expected mid-run recompile
+        # (e.g. a curriculum shape change).
+        if self._watchdog is not None and not compile_pending:
+            self._watchdog.arm()
 
         if self.wall_clock_breakdown:
             self.timers(FORWARD_MICRO_TIMER).start()
@@ -1349,42 +1397,60 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=at_boundary)
 
     def _take_model_step(self):
-        if self.wall_clock_breakdown:
-            self.timers(STEP_MICRO_TIMER).start()
-        if self._offload_opt is not None:
-            overflow = self._take_offload_step()
-        else:
-            if self._apply_fn is None:
-                self._apply_fn = self._build_apply()
-            (
-                self._params, self._opt_state, self._acc_grads,
-                self._ls_state, overflow, grad_norm,
-            ) = self._apply_fn(
-                self._params, self._opt_state, self._acc_grads,
-                self._ls_state, self._lr_factor_now()
-            )
-            # fp16 short-circuit first: bool(overflow) on the device
-            # scalar would force a host sync every step in bf16/f32 mode
-            if (self._compressed_mode is None
-                    or self._compressed_norm_available) and not (
-                    self.fp16_enabled and bool(overflow)):
-                self._last_grad_norm = grad_norm
-        self.global_steps += 1
-        self._post_step_bookkeeping(overflow, self._step_losses)
-        self._step_losses = []
-        if self.wall_clock_breakdown:
-            self.timers(STEP_MICRO_TIMER).stop()
-            self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER])
+        try:
+            if self.wall_clock_breakdown:
+                self.timers(STEP_MICRO_TIMER).start()
+            if self._offload_opt is not None:
+                overflow = self._take_offload_step()
+            else:
+                if self._apply_fn is None:
+                    self._apply_fn = self._build_apply()
+                (
+                    self._params, self._opt_state, self._acc_grads,
+                    self._ls_state, overflow, grad_norm,
+                ) = self._apply_fn(
+                    self._params, self._opt_state, self._acc_grads,
+                    self._ls_state, self._lr_factor_now()
+                )
+                # gate short-circuit first: bool(overflow) on the device
+                # scalar would force a host sync every step when neither
+                # fp16 nor the sentinel's non-finite guard is on
+                if (self._compressed_mode is None
+                        or self._compressed_norm_available) and not (
+                        self._check_overflow and bool(overflow)):
+                    self._last_grad_norm = grad_norm
+            self.global_steps += 1
+            self._post_step_bookkeeping(overflow, self._step_losses)
+            self._step_losses = []
+            if self.wall_clock_breakdown:
+                self.timers(STEP_MICRO_TIMER).stop()
+                self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER])
+        finally:
+            # the step boundary is the heartbeat's end, even when the
+            # bookkeeping raised (DivergenceError must not leave the
+            # watchdog armed over user exception handling)
+            if self._watchdog is not None:
+                self._watchdog.disarm()
 
     def _post_step_bookkeeping(self, overflow, step_losses):
         """Host tail shared by the fused and unfused step paths: overflow
-        accounting, lr schedule, PLD, MoQ, progress + monitor events."""
-        if self.fp16_enabled and bool(overflow):
+        accounting, lr schedule, PLD, MoQ, sentinel verdict, progress +
+        monitor events."""
+        update_skipped = self._check_overflow and bool(overflow)
+        if update_skipped:
             self.skipped_steps += 1
-            log_dist(
-                f"overflow at step {self.global_steps}; loss scale -> "
-                f"{float(self._ls_state.scale)}", ranks=[0],
-            )
+            if self.fp16_enabled:
+                log_dist(
+                    f"overflow at step {self.global_steps}; loss scale -> "
+                    f"{float(self._ls_state.scale)}", ranks=[0],
+                )
+            else:
+                # the sentinel's non-finite guard tripped in-graph: the
+                # optimizer state is untouched, only the batch was burned
+                log_dist(
+                    f"non-finite gradients at step {self.global_steps}; "
+                    f"update skipped (sentinel)", ranks=[0],
+                )
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
             # torch parity: an explicit scheduler re-asserts the schedule
@@ -1396,7 +1462,7 @@ class DeepSpeedEngine:
             self._rng, qrng = jax.random.split(self._rng)
             quantized = self.quantizer.quantize(
                 self._params,
-                overflow=self.fp16_enabled and bool(overflow),
+                overflow=update_skipped,
                 eigenvalue_enabled=self.quantizer.q_eigenvalue,
                 rng=qrng)
             if self._reshard_params_fn is None:
@@ -1405,8 +1471,7 @@ class DeepSpeedEngine:
                 self._reshard_params_fn = jax.jit(
                     lambda t: t, out_shardings=self._param_shardings)
             self._params = self._reshard_params_fn(quantized)
-        if self.compression_compressor is not None and not (
-                self.fp16_enabled and bool(overflow)):
+        if self.compression_compressor is not None and not update_skipped:
             self._rng, crng = jax.random.split(self._rng)
             compressed = self.compression_compressor.jitted_apply(
                 self._params, self.global_steps, key=crng)
@@ -1451,6 +1516,8 @@ class DeepSpeedEngine:
                   float(np.mean([float(l) for l in step_losses])),
                   self.global_samples)]
             )
+        if self.sentinel is not None:
+            self._sentinel_observe(update_skipped, step_losses)
         if self._preempt_signum is not None:
             self._graceful_shutdown()
 
@@ -1495,29 +1562,37 @@ class DeepSpeedEngine:
             batch = self._apply_curriculum(batch)
         if not self._initialized:
             self._init_state(batch)
-        if self._train_step_fn is None:
+        compile_pending = self._train_step_fn is None
+        if compile_pending:
             self._train_step_fn = self._build_train_step()
+        # arm the hang watchdog around the dispatched step (skipped on
+        # the compiling first call — see forward())
+        if self._watchdog is not None and not compile_pending:
+            self._watchdog.arm()
+        try:
+            self.tput_timer.start()
+            device_batch = self._put_batch(batch)
+            (self._params, self._opt_state, self._ls_state, loss, overflow,
+             grad_norm) = self._train_step_fn(
+                self._params, self._opt_state, self._ls_state, device_batch,
+                self._rng, self.micro_steps, self._lr_factor_now())
+            if (self._compressed_mode is None
+                    or self._compressed_norm_available) and not (
+                    self._check_overflow and bool(overflow)):
+                self._last_grad_norm = grad_norm
+            self._last_loss = loss
+            self.micro_steps += 1
+            self.global_steps += 1
+            self.global_samples += (
+                self.train_micro_batch_size_per_gpu
+                * self.topology.data_parallel_size)
 
-        self.tput_timer.start()
-        device_batch = self._put_batch(batch)
-        (self._params, self._opt_state, self._ls_state, loss, overflow,
-         grad_norm) = self._train_step_fn(
-            self._params, self._opt_state, self._ls_state, device_batch,
-            self._rng, self.micro_steps, self._lr_factor_now())
-        if (self._compressed_mode is None
-                or self._compressed_norm_available) and not (
-                self.fp16_enabled and bool(overflow)):
-            self._last_grad_norm = grad_norm
-        self._last_loss = loss
-        self.micro_steps += 1
-        self.global_steps += 1
-        self.global_samples += (
-            self.train_micro_batch_size_per_gpu
-            * self.topology.data_parallel_size)
-
-        self._post_step_bookkeeping(overflow, [loss])
-        self.tput_timer.stop(global_step=True)
-        return loss
+            self._post_step_bookkeeping(overflow, [loss])
+            self.tput_timer.stop(global_step=True)
+            return loss
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.disarm()
 
     def eval_batch(self, batch: Dict[str, Any]):
         set_default_topology(self.topology)
@@ -1656,6 +1731,11 @@ class DeepSpeedEngine:
         self.ft_stats["graceful_shutdowns"] += 1
         self._emit_ft_events()
         if cfg.exit_after_save:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+            if self.monitor is not None:
+                # flush/close TB, wandb and CSV before the process dies
+                self.monitor.close()
             raise SystemExit(cfg.exit_code)
 
     def _emit_ft_events(self):
@@ -1668,6 +1748,92 @@ class DeepSpeedEngine:
         counters["ckpt_io_retries"] = self.checkpoint_engine.io_retry_count
         self.monitor.write_events(
             counter_events("FaultTolerance", counters, self.global_steps))
+
+    # ------------------------------------------------------------------
+    # training health sentinel (docs/recovery.md "Divergence and hang
+    # recovery"): detect → skip → rollback → diverge
+    # ------------------------------------------------------------------
+    def _sentinel_observe(self, update_skipped, step_losses):
+        from deepspeed_tpu.runtime.sentinel import (
+            VERDICT_ANOMALY,
+            VERDICT_DIVERGED,
+            VERDICT_ROLLBACK,
+        )
+
+        loss = None
+        if step_losses:
+            loss = float(np.mean([float(l) for l in step_losses]))
+        verdict, reason = self.sentinel.observe(
+            loss=loss, grad_norm=self.get_global_grad_norm(),
+            update_skipped=update_skipped, fp16=self.fp16_enabled,
+            step=self.global_steps)
+        if verdict == VERDICT_ANOMALY:
+            logger.warning("sentinel: %s", reason)
+        elif verdict == VERDICT_ROLLBACK:
+            logger.warning("sentinel: %s", reason)
+            self._sentinel_rollback(reason)
+        elif verdict == VERDICT_DIVERGED:
+            self._sentinel_divergence(reason)  # raises
+        self._emit_sentinel_events()
+
+    def _sentinel_rollback(self, reason):
+        """Restore the newest manifest-valid checkpoint and reseed the
+        data order — replaying the exact batch sequence that just
+        diverged would diverge again."""
+        cfg = self._config.sentinel
+        load_dir = cfg.rollback_dir
+        tag = (ckpt_manifest.latest_valid_tag(load_dir)
+               if load_dir else None)
+        if tag is None:
+            self._sentinel_divergence(
+                reason + ("; no manifest-valid checkpoint to roll back "
+                          f"to in {load_dir}" if load_dir else
+                          "; sentinel.rollback_dir is not set"))
+        self.sentinel.note_rollback()
+        log_dist(
+            f"sentinel: rolling back to manifest-valid tag {tag} "
+            f"({self.sentinel.stats['rollbacks']}/{cfg.rollback_budget} "
+            f"rollbacks used)", ranks=[0])
+        self.load_checkpoint(load_dir, tag=tag)
+        loader = self.training_dataloader
+        if (cfg.reseed_on_rollback and loader is not None
+                and hasattr(loader, "reseed")):
+            # offset by the rollback count: each re-entry gets a distinct
+            # order, deterministically derived from the base seed
+            loader.reseed(self.sentinel.stats["rollbacks"])
+            log_dist(
+                f"sentinel: reseeded data order (seed -> {loader.seed})",
+                ranks=[0])
+
+    def _sentinel_divergence(self, reason):
+        from deepspeed_tpu.runtime.sentinel import DivergenceError
+
+        cfg = self._config.sentinel
+        self._emit_sentinel_events()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        logger.error("sentinel: training diverged: %s", reason)
+        raise DivergenceError(
+            f"training diverged: {reason}. Workers should exit with code "
+            f"{cfg.divergence_exit_code} (DivergenceError.exit_code) so "
+            f"the elastic agent stops restart-looping into it.",
+            cfg.divergence_exit_code)
+
+    def _emit_sentinel_events(self):
+        """Export the sentinel counters as ``Sentinel/*`` monitor events
+        whenever they changed (the _emit_ft_events pattern; a healthy run
+        writes nothing)."""
+        if (self.sentinel is None or self.monitor is None
+                or not getattr(self.monitor, "enabled", False)):
+            return
+        counters = self.sentinel.counters()
+        if counters == self._sentinel_emitted:
+            return
+        from deepspeed_tpu.monitor.monitor import counter_events
+
+        self.monitor.write_events(
+            counter_events("Sentinel", counters, self.global_steps))
+        self._sentinel_emitted = counters
 
     # ------------------------------------------------------------------
     # checkpoint (reference engine.py:2545 load / :2889 save)
@@ -1751,6 +1917,11 @@ class DeepSpeedEngine:
                              if self.lr_scheduler else {}),
             "client_state": client_state,
         }
+        # data-order state (epoch + seed): restore resumes the order
+        # instead of restarting the epoch (rollback/resume parity)
+        if (self.training_dataloader is not None
+                and hasattr(self.training_dataloader, "state_dict")):
+            meta["dataloader"] = self.training_dataloader.state_dict()
         import pickle
 
         # routed through the checkpoint engine (pickled meta as a uint8
@@ -1903,6 +2074,10 @@ class DeepSpeedEngine:
         self.global_samples = int(meta["global_samples"])
         self.micro_steps = int(meta["micro_steps"])
         self.skipped_steps = int(meta["skipped_steps"])
+        dl_state = meta.get("dataloader")
+        if (dl_state and self.training_dataloader is not None
+                and hasattr(self.training_dataloader, "load_state_dict")):
+            self.training_dataloader.load_state_dict(dl_state)
         if load_lr_scheduler_states and self.lr_scheduler is not None and (
             meta.get("lr_scheduler")
         ):

@@ -1,0 +1,301 @@
+"""Training health sentinel: anomaly detection, graduated response, hang
+watchdog (docs/recovery.md "Divergence and hang recovery").
+
+PR 1 made crashes survivable; this module covers the runs that *stay up
+while going wrong*. Pod-scale TPU training treats NaN bursts, loss spikes,
+and wedged collectives as routine events to absorb, not fatal ones — the
+engine detects them host-side off values the step already returns (no
+extra device sync), and repairs them with the manifest/newest-valid-tag
+machinery from ``checkpoint_manifest.py``:
+
+* :class:`TrainingSentinel` — per-step verdicts from a non-finite check
+  (any dtype, not just the fp16 loss-scale path) plus rolling-window
+  z-score/ratio spike detection on loss and grad norm; consecutive
+  anomalies first burn a bounded skip budget, then escalate to rollback,
+  then — once the rollback budget is spent — to :class:`DivergenceError`.
+* :class:`HangWatchdog` — a daemon-thread heartbeat armed around each
+  step; on timeout it dumps every Python thread stack and either warns or
+  aborts the process with its own exit code.
+* :class:`DivergenceError` — carries a distinct exit code so the elastic
+  agent can tell "diverged" (restarting replays the failure) from
+  "crashed" (restarting is the fix) and stop restart-looping.
+
+Deliberately jax-free (stdlib + the config object's attributes) so
+supervisors and agent-side tooling can import it without a runtime.
+"""
+
+import math
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+# verdicts returned by TrainingSentinel.observe
+VERDICT_OK = "ok"
+VERDICT_ANOMALY = "anomaly"
+VERDICT_ROLLBACK = "rollback"
+VERDICT_DIVERGED = "diverged"
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged past its rollback budget. Carries ``exit_code``
+    (default :data:`constants.DIVERGENCE_EXIT_CODE_DEFAULT`) so worker
+    scripts can ``sys.exit(e.exit_code)`` and the elastic agent can stop
+    restart-looping into the same divergence."""
+
+    def __init__(self, message: str,
+                 exit_code: int = C.DIVERGENCE_EXIT_CODE_DEFAULT):
+        super().__init__(message)
+        self.exit_code = int(exit_code)
+
+
+def _finite(value: Optional[float]) -> bool:
+    return value is not None and math.isfinite(value)
+
+
+class TrainingSentinel:
+    """Host-side anomaly detector with a graduated response policy.
+
+    ``observe()`` is called once per optimizer step with the loss and
+    grad norm the step already materialized. It returns a
+    ``(verdict, reason)`` pair; the ENGINE owns the repair actions
+    (the sentinel never touches device state):
+
+    * ``ok`` — healthy step, windows updated;
+    * ``anomaly`` — bad step inside the skip budget: the engine has
+      already cond-skipped the update (non-finite) or should simply move
+      to the next batch (spike);
+    * ``rollback`` — consecutive anomalies exceeded ``skip_budget`` and
+      rollbacks remain in budget: restore the newest manifest-valid
+      checkpoint and (optionally) reseed the data order;
+    * ``diverged`` — the rollback budget is spent too; raise
+      :class:`DivergenceError`.
+
+    Spike detection only engages once ``min_window`` healthy samples are
+    banked, so warmup noise cannot trip it; anomalous samples are never
+    added to the windows, so a NaN burst cannot poison the baseline it is
+    judged against.
+    """
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        window = int(cfg.window)
+        self._losses = deque(maxlen=window)
+        self._grad_norms = deque(maxlen=window)
+        self._consecutive = 0
+        self.stats: Dict[str, int] = {
+            "nonfinite_steps": 0,
+            "loss_spikes": 0,
+            "grad_spikes": 0,
+            "batch_skips": 0,
+            "rollbacks": 0,
+            "divergences": 0,
+            "watchdog_fires": 0,
+        }
+
+    # -- detection -----------------------------------------------------
+    def _spike(self, value: float, window, zscore_thr: float,
+               ratio_thr: float) -> Optional[str]:
+        if len(window) < max(int(self.cfg.min_window), 2):
+            return None
+        mean = sum(window) / len(window)
+        if ratio_thr and ratio_thr > 0 and mean > 0 \
+                and value > ratio_thr * mean:
+            return f"{value:.4g} > {ratio_thr:g}x window mean {mean:.4g}"
+        if zscore_thr and zscore_thr > 0:
+            var = sum((x - mean) ** 2 for x in window) / len(window)
+            std = math.sqrt(var)
+            if std > 0 and (value - mean) / std > zscore_thr:
+                return (f"z-score {(value - mean) / std:.1f} > "
+                        f"{zscore_thr:g} (mean {mean:.4g}, std {std:.4g})")
+        return None
+
+    def observe(self, loss: Optional[float], grad_norm: Optional[float] = None,
+                update_skipped: bool = False, fp16: bool = False,
+                step: int = 0) -> Tuple[str, str]:
+        """Judge one optimizer step. ``update_skipped`` is the in-graph
+        overflow gate's decision; under fp16 a routine loss-scale overflow
+        (finite loss) belongs to the loss scaler and does NOT count
+        against the sentinel budget."""
+        anomaly = None
+        # None means "not observed this step" (e.g. no grad norm under a
+        # compressed optimizer), never an anomaly by itself
+        nonfinite = ((loss is not None and not math.isfinite(loss))
+                     or (grad_norm is not None
+                         and not math.isfinite(grad_norm))
+                     or (update_skipped and not fp16))
+        if nonfinite and getattr(self.cfg, "check_nonfinite", True):
+            self.stats["nonfinite_steps"] += 1
+            anomaly = f"non-finite loss/grads at step {step} (loss={loss})"
+        elif _finite(loss):
+            reason = self._spike(loss, self._losses,
+                                 self.cfg.loss_spike_zscore,
+                                 self.cfg.loss_spike_ratio)
+            if reason is not None:
+                self.stats["loss_spikes"] += 1
+                anomaly = f"loss spike at step {step}: {reason}"
+            elif _finite(grad_norm):
+                reason = self._spike(grad_norm, self._grad_norms,
+                                     self.cfg.grad_spike_zscore,
+                                     self.cfg.grad_spike_ratio)
+                if reason is not None:
+                    self.stats["grad_spikes"] += 1
+                    anomaly = f"grad-norm spike at step {step}: {reason}"
+
+        if update_skipped and (anomaly is not None or not fp16):
+            self.stats["batch_skips"] += 1
+
+        if anomaly is None:
+            self._consecutive = 0
+            if _finite(loss):
+                self._losses.append(float(loss))
+            if _finite(grad_norm):
+                self._grad_norms.append(float(grad_norm))
+            return VERDICT_OK, ""
+
+        self._consecutive += 1
+        if self._consecutive <= int(self.cfg.skip_budget):
+            return VERDICT_ANOMALY, (
+                f"{anomaly} [{self._consecutive}/{self.cfg.skip_budget} "
+                f"consecutive before rollback]")
+        if self.stats["rollbacks"] >= int(self.cfg.rollback_budget):
+            self.stats["divergences"] += 1
+            return VERDICT_DIVERGED, (
+                f"{anomaly}; skip budget ({self.cfg.skip_budget}) and "
+                f"rollback budget ({self.cfg.rollback_budget}) exhausted")
+        return VERDICT_ROLLBACK, (
+            f"{anomaly}; {self._consecutive} consecutive anomalies "
+            f"exceed skip budget {self.cfg.skip_budget}")
+
+    # -- state transitions driven by the engine ------------------------
+    def note_rollback(self):
+        """A rollback happened: the restored state predates the window, so
+        the baseline restarts clean (stale samples would mis-judge the
+        post-restore loss level)."""
+        self.stats["rollbacks"] += 1
+        self._consecutive = 0
+        self._losses.clear()
+        self._grad_norms.clear()
+
+    def note_watchdog_fire(self, dump: str = ""):
+        self.stats["watchdog_fires"] += 1
+
+    def counters(self) -> Dict[str, int]:
+        return dict(self.stats)
+
+
+def dump_thread_stacks() -> str:
+    """Format the current stack of every Python thread (the hang
+    post-mortem: WHERE each thread is stuck, e.g. blocked in a collective
+    or a host transfer)."""
+    frames = sys._current_frames()
+    chunks = []
+    for t in threading.enumerate():
+        chunks.append(f"--- thread {t.name} (ident={t.ident}, "
+                      f"daemon={t.daemon}) ---")
+        frame = frames.get(t.ident)
+        if frame is None:
+            chunks.append("  <no frame>")
+        else:
+            chunks.append("".join(traceback.format_stack(frame)).rstrip())
+    return "\n".join(chunks)
+
+
+class HangWatchdog:
+    """Daemon-thread heartbeat: ``arm()`` before dispatching a step (and
+    again at every sign of progress — re-arming IS the heartbeat),
+    ``disarm()`` when the step completes. If the deadline passes while
+    armed, the watchdog dumps all thread stacks and either warns (and
+    pushes the deadline so it doesn't spam) or aborts the process with
+    ``exit_code`` via ``os._exit`` — a hung collective cannot be unwound
+    with an exception from another thread.
+
+    ``clock``/``abort_fn``/``poll_once()`` are test seams: drive a fake
+    monotonic clock and call ``poll_once()`` directly, no sleeping.
+    """
+
+    def __init__(self, timeout_s: float, action: str = "warn",
+                 exit_code: int = C.SENTINEL_HANG_EXIT_CODE_DEFAULT,
+                 poll_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_fire: Optional[Callable[[str], None]] = None,
+                 abort_fn: Optional[Callable[[int], None]] = None):
+        if action not in ("warn", "abort"):
+            raise ValueError(f"HangWatchdog action must be 'warn' or "
+                             f"'abort', got {action!r}")
+        self.timeout_s = float(timeout_s)
+        self.action = action
+        self.exit_code = int(exit_code)
+        self._clock = clock
+        self._poll_s = poll_s if poll_s is not None else min(
+            1.0, max(0.02, self.timeout_s / 10.0))
+        self._on_fire = on_fire
+        self._abort = abort_fn if abort_fn is not None else os._exit
+        self._lock = threading.Lock()
+        self._deadline: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = 0
+        self.last_dump: Optional[str] = None
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="ds-tpu-hang-watchdog", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._poll_s):
+            self.poll_once()
+
+    def arm(self):
+        """(Re)start the countdown — call at every sign of step progress."""
+        with self._lock:
+            self._deadline = self._clock() + self.timeout_s
+
+    def disarm(self):
+        with self._lock:
+            self._deadline = None
+
+    def stop(self):
+        self._stop.set()
+        self.disarm()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self._poll_s * 4, 1.0))
+            self._thread = None
+
+    def poll_once(self) -> bool:
+        """One deadline check (the daemon loop body; also the test seam).
+        Returns True when the watchdog fired."""
+        with self._lock:
+            deadline = self._deadline
+            if deadline is None or self._clock() < deadline:
+                return False
+            # warn: push the deadline one timeout out so a persistent hang
+            # re-warns periodically instead of spamming every poll; abort:
+            # clear it (the process is going down)
+            self._deadline = (self._clock() + self.timeout_s
+                              if self.action == "warn" else None)
+        self.fired += 1
+        dump = dump_thread_stacks()
+        self.last_dump = dump
+        logger.error(
+            "hang watchdog: no step progress within %.1fs (action=%s). "
+            "Thread stacks:\n%s", self.timeout_s, self.action, dump)
+        if self._on_fire is not None:
+            try:
+                self._on_fire(dump)
+            except Exception:  # never let telemetry mask the dump
+                logger.exception("hang watchdog on_fire callback failed")
+        if self.action == "abort":
+            logger.error("hang watchdog: aborting with exit code %d",
+                         self.exit_code)
+            self._abort(self.exit_code)
+        return True

@@ -13,7 +13,12 @@ failures reproducible in unit tests:
   power loss without fsync);
 * :func:`truncate_file` — post-hoc corruption of a file on disk;
 * :func:`kill_at_step` — deliver a signal to a supervised child when a
-  step file it writes reaches a chosen step (preemption at step K).
+  step file it writes reaches a chosen step (preemption at step K);
+* :func:`nan_at_step` / :func:`spike_at_step` / :func:`hang_at_step` —
+  corrupt or stall an engine's input batches from a chosen step, the
+  training-health faults (NaN loss, loss spike, wedged step) that drive
+  the sentinel's detect→skip→rollback→diverge path end-to-end
+  (docs/recovery.md "Divergence and hang recovery").
 
 Everything here is process-global monkeypatching of ``builtins.open`` /
 ``os.replace`` — test-only machinery, deliberately free of jax imports so
@@ -164,3 +169,88 @@ def kill_at_step(proc, step_file: str, step: int,
     finally:
         stop.set()
         watcher.join(timeout=timeout_s)
+
+
+# ---------------------------------------------------------------------------
+# training-health faults (sentinel chaos; numpy-only, still jax-free)
+# ---------------------------------------------------------------------------
+def _map_float_leaves(batch, fn):
+    """Apply ``fn`` to every floating-point array leaf of a batch pytree
+    (dict / tuple / list / array), leaving integer leaves (token ids,
+    masks) untouched."""
+    import numpy as np
+
+    if isinstance(batch, dict):
+        return {k: _map_float_leaves(v, fn) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_map_float_leaves(v, fn) for v in batch)
+    arr = np.asarray(batch)
+    if np.issubdtype(arr.dtype, np.floating):
+        return fn(arr)
+    return batch
+
+
+@contextmanager
+def _batch_fault(engine, step: int, times: Optional[int],
+                 apply: Callable):
+    """Wrap ``engine._put_batch`` so ``apply(batch)`` fires on batches
+    dispatched at ``engine.global_steps >= step``, at most ``times``
+    times (None = every matching batch). Count-limiting is what lets a
+    run RECOVER after the sentinel rolls back — the fault stops firing
+    and training continues clean."""
+    injector = Injector()
+    real_put = engine._put_batch  # bound method (class attr lookup)
+
+    def faulty_put(batch):
+        if engine.global_steps >= step and (
+                times is None or injector.injected < times):
+            injector._bump()
+            batch = apply(batch)
+        return real_put(batch)
+
+    engine._put_batch = faulty_put  # instance attr shadows the method
+    try:
+        yield injector
+    finally:
+        engine.__dict__.pop("_put_batch", None)
+
+
+@contextmanager
+def nan_at_step(engine, step: int, times: Optional[int] = 1):
+    """Poison the float leaves of input batches with NaN from global step
+    ``step`` on (at most ``times`` batches) — the bf16 divergence that
+    the fp16 loss-scale path never sees."""
+    import numpy as np
+
+    def poison(batch):
+        return _map_float_leaves(batch, lambda a: np.full_like(a, np.nan))
+
+    with _batch_fault(engine, step, times, poison) as injector:
+        yield injector
+
+
+@contextmanager
+def spike_at_step(engine, step: int, scale: float = 1e3,
+                  times: Optional[int] = 1):
+    """Scale the float leaves of input batches by ``scale`` from global
+    step ``step`` on — a finite loss spike (bad shard, corrupt record)
+    that the non-finite check cannot catch but the window should."""
+    def amplify(batch):
+        return _map_float_leaves(batch, lambda a: a * scale)
+
+    with _batch_fault(engine, step, times, amplify) as injector:
+        yield injector
+
+
+@contextmanager
+def hang_at_step(engine, step: int, seconds: float,
+                 times: Optional[int] = 1):
+    """Stall batch dispatch for ``seconds`` at global step ``step`` — a
+    fake wedged step (hung collective / dead host transfer) for the hang
+    watchdog to catch."""
+    def stall(batch):
+        time.sleep(seconds)
+        return batch
+
+    with _batch_fault(engine, step, times, stall) as injector:
+        yield injector

@@ -208,3 +208,74 @@ def test_graceful_shutdown_unknown_signal_raises():
             },
             dp_world_size=1,
         )
+
+
+def test_sentinel_block_roundtrip():
+    cfg = DeepSpeedConfig(
+        {
+            "train_batch_size": 8,
+            "sentinel": {
+                "enabled": True,
+                "check_nonfinite": False,
+                "window": 30,
+                "min_window": 5,
+                "loss_spike_zscore": 4.0,
+                "loss_spike_ratio": 2.5,
+                "grad_spike_zscore": 5.0,
+                "grad_spike_ratio": 8.0,
+                "skip_budget": 7,
+                "rollback_budget": 4,
+                "rollback_dir": "/tmp/ckpt",
+                "reseed_on_rollback": False,
+                "divergence_exit_code": 77,
+                "hang_timeout_s": 120.0,
+                "hang_action": "abort",
+                "hang_exit_code": 78,
+            },
+        },
+        dp_world_size=1,
+    )
+    sn = cfg.sentinel
+    assert sn.enabled is True and sn.check_nonfinite is False
+    assert sn.window == 30 and sn.min_window == 5
+    assert sn.loss_spike_zscore == 4.0 and sn.loss_spike_ratio == 2.5
+    assert sn.grad_spike_zscore == 5.0 and sn.grad_spike_ratio == 8.0
+    assert sn.skip_budget == 7 and sn.rollback_budget == 4
+    assert sn.rollback_dir == "/tmp/ckpt" and sn.reseed_on_rollback is False
+    assert sn.divergence_exit_code == 77
+    assert sn.hang_timeout_s == 120.0
+    assert sn.hang_action == "abort" and sn.hang_exit_code == 78
+
+
+def test_sentinel_defaults_disabled():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=1)
+    sn = cfg.sentinel
+    assert sn.enabled is False and sn.check_nonfinite is True
+    assert sn.window == 50 and sn.min_window == 10
+    assert sn.skip_budget == 3 and sn.rollback_budget == 2
+    assert sn.rollback_dir is None and sn.reseed_on_rollback is True
+    # exit-code protocol: 13 = diverged (do not restart), 14 = hang abort
+    assert sn.divergence_exit_code == 13
+    assert sn.hang_timeout_s == 0.0  # watchdog disabled
+    assert sn.hang_action == "warn" and sn.hang_exit_code == 14
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {"window": 1},
+        {"min_window": 1},
+        {"window": 10, "min_window": 11},
+        {"skip_budget": -1},
+        {"rollback_budget": -1},
+        {"hang_timeout_s": -0.5},
+        {"hang_action": "explode"},
+        {"divergence_exit_code": 0},
+        {"hang_exit_code": 256},
+    ],
+)
+def test_sentinel_validation_rejects(bad):
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {"train_batch_size": 8, "sentinel": bad}, dp_world_size=1
+        )

@@ -311,7 +311,9 @@ def test_elastic_agent_crash_loop_detection(tmp_path):
     from deepspeed_tpu.elasticity.elastic_agent import (
         CrashLoopError, DSElasticAgent)
 
-    worker = _write_worker(tmp_path, "import sys; sys.exit(13)")
+    # exit code 9: an ordinary crash (13 is reserved for divergence,
+    # which the agent deliberately does NOT restart — test_sentinel.py)
+    worker = _write_worker(tmp_path, "import sys; sys.exit(9)")
     agent = DSElasticAgent([sys.executable, worker], {},
                            discover_world=lambda: 1, max_restarts=10,
                            backoff_s=0.0, jitter=0.0,

@@ -1,0 +1,520 @@
+"""Training health sentinel tests: anomaly verdicts (non-finite, spike
+windows, budgets), the hang watchdog on a fake clock, dataloader
+state/reseed, monitor batching/close, and the end-to-end chaos path —
+NaN injection → bounded skips → rollback to the newest manifest-valid
+tag → recovery with a different data order (docs/recovery.md
+"Divergence and hang recovery"). Run standalone via ``make chaos``."""
+
+import builtins
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime import checkpoint_manifest as cm
+from deepspeed_tpu.runtime.config import (
+    CsvConfig,
+    DeepSpeedConfig,
+    SentinelConfig,
+)
+from deepspeed_tpu.runtime.dataloader import (
+    DeepSpeedDataLoader,
+    RepeatingLoader,
+)
+from deepspeed_tpu.runtime.sentinel import (
+    VERDICT_ANOMALY,
+    VERDICT_DIVERGED,
+    VERDICT_OK,
+    VERDICT_ROLLBACK,
+    DivergenceError,
+    HangWatchdog,
+    TrainingSentinel,
+)
+from deepspeed_tpu.utils import fault_injection as fi
+
+from unit.simple_model import SimpleModel, random_dataset
+
+NAN = float("nan")
+
+
+def sentinel(**overrides):
+    cfg = dict(enabled=True, window=20, min_window=5, skip_budget=2,
+               rollback_budget=1)
+    cfg.update(overrides)
+    return TrainingSentinel(SentinelConfig.from_dict(cfg))
+
+
+# ---------------------------------------------------------------------------
+# verdicts: non-finite, spikes, budgets (pure host, no engine)
+# ---------------------------------------------------------------------------
+def test_nonfinite_loss_trips_anomaly():
+    s = sentinel()
+    verdict, reason = s.observe(loss=NAN, step=1)
+    assert verdict == VERDICT_ANOMALY and "non-finite" in reason
+    assert s.stats["nonfinite_steps"] == 1
+    # healthy step resets the consecutive counter
+    assert s.observe(loss=1.0, step=2)[0] == VERDICT_OK
+    verdict, reason = s.observe(loss=NAN, step=3)
+    assert verdict == VERDICT_ANOMALY and "1/2" in reason  # counter restarted
+
+
+def test_nonfinite_grad_norm_trips_even_with_finite_loss():
+    s = sentinel()
+    verdict, _ = s.observe(loss=1.0, grad_norm=float("inf"), step=1)
+    assert verdict == VERDICT_ANOMALY
+    assert s.stats["nonfinite_steps"] == 1
+
+
+def test_fp16_routine_overflow_is_not_an_anomaly():
+    """A loss-scale overflow under fp16 (finite loss, skipped update)
+    belongs to the loss scaler, not the sentinel budget."""
+    s = sentinel()
+    for step in range(10):  # way past any budget
+        verdict, _ = s.observe(loss=1.0, update_skipped=True, fp16=True,
+                               step=step)
+        assert verdict == VERDICT_OK
+    assert s.stats["batch_skips"] == 0
+    # but a non-finite LOSS under fp16 is still an anomaly
+    assert s.observe(loss=NAN, update_skipped=True, fp16=True,
+                     step=11)[0] == VERDICT_ANOMALY
+
+
+def test_skipped_update_without_fp16_counts_as_nonfinite():
+    s = sentinel()
+    verdict, _ = s.observe(loss=1.0, update_skipped=True, fp16=False, step=1)
+    assert verdict == VERDICT_ANOMALY
+    assert s.stats["nonfinite_steps"] == 1
+    assert s.stats["batch_skips"] == 1
+
+
+def test_loss_spike_trips_after_warmup():
+    s = sentinel(loss_spike_ratio=3.0, loss_spike_zscore=6.0)
+    rng = np.random.RandomState(0)
+    for step in range(10):
+        assert s.observe(loss=1.0 + 0.05 * rng.randn(),
+                         step=step)[0] == VERDICT_OK
+    verdict, reason = s.observe(loss=10.0, step=10)
+    assert verdict == VERDICT_ANOMALY and "loss spike" in reason
+    assert s.stats["loss_spikes"] == 1
+
+
+def test_spike_does_not_trip_during_warmup():
+    """min_window healthy samples are required before spike checks arm —
+    warmup noise (huge early losses) must not burn the skip budget."""
+    s = sentinel(min_window=10)
+    for step, loss in enumerate([12.0, 3.0, 1.5, 1.0, 0.9]):
+        assert s.observe(loss=loss, step=step)[0] == VERDICT_OK
+    assert s.stats["loss_spikes"] == 0
+
+
+def test_in_window_noise_does_not_trip():
+    s = sentinel()
+    rng = np.random.RandomState(1)
+    for step in range(15):
+        assert s.observe(loss=1.0 + 0.1 * rng.randn(),
+                         step=step)[0] == VERDICT_OK
+    assert s.observe(loss=1.25, step=15)[0] == VERDICT_OK  # inside noise
+    assert s.stats["loss_spikes"] == 0
+
+
+def test_grad_norm_spike_trips():
+    s = sentinel(grad_spike_ratio=10.0)
+    for step in range(10):
+        assert s.observe(loss=1.0, grad_norm=2.0, step=step)[0] == VERDICT_OK
+    verdict, reason = s.observe(loss=1.0, grad_norm=50.0, step=10)
+    assert verdict == VERDICT_ANOMALY and "grad-norm spike" in reason
+    assert s.stats["grad_spikes"] == 1
+
+
+def test_skip_budget_exhaustion_escalates_to_rollback():
+    s = sentinel(skip_budget=2, rollback_budget=1)
+    assert s.observe(loss=NAN, step=1)[0] == VERDICT_ANOMALY
+    assert s.observe(loss=NAN, step=2)[0] == VERDICT_ANOMALY
+    verdict, reason = s.observe(loss=NAN, step=3)
+    assert verdict == VERDICT_ROLLBACK and "exceed skip budget" in reason
+
+
+def test_rollback_budget_exhaustion_escalates_to_diverged():
+    s = sentinel(skip_budget=1, rollback_budget=1)
+    s.observe(loss=NAN, step=1)
+    assert s.observe(loss=NAN, step=2)[0] == VERDICT_ROLLBACK
+    s.note_rollback()
+    assert s.stats["rollbacks"] == 1
+    # windows and the consecutive counter restart clean after rollback
+    assert s.observe(loss=NAN, step=3)[0] == VERDICT_ANOMALY
+    verdict, reason = s.observe(loss=NAN, step=4)
+    assert verdict == VERDICT_DIVERGED and "rollback budget" in reason
+    assert s.stats["divergences"] == 1
+
+
+def test_anomalous_samples_never_enter_the_window():
+    """A NaN burst must not poison the baseline it is judged against."""
+    s = sentinel(skip_budget=100)
+    for step in range(10):
+        s.observe(loss=1.0, step=step)
+    for step in range(10, 15):
+        s.observe(loss=NAN, step=step)
+    # baseline still ~1.0: a return to 1.0 is healthy, a 10x is a spike
+    assert s.observe(loss=1.0, step=15)[0] == VERDICT_OK
+    assert s.observe(loss=10.0, step=16)[0] == VERDICT_ANOMALY
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog on a fake clock (no threads, no sleeping)
+# ---------------------------------------------------------------------------
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_watchdog_fires_on_stalled_step():
+    clock = FakeClock()
+    fires = []
+    wd = HangWatchdog(timeout_s=10.0, action="warn", clock=clock,
+                      on_fire=fires.append)
+    wd.arm()
+    clock.now = 5.0
+    assert wd.poll_once() is False
+    clock.now = 10.5
+    assert wd.poll_once() is True
+    assert wd.fired == 1 and len(fires) == 1
+    # the dump names this thread and the watchdog module
+    assert "MainThread" in wd.last_dump
+    # warn mode pushes the deadline instead of spamming every poll
+    clock.now = 11.0
+    assert wd.poll_once() is False
+    clock.now = 21.0
+    assert wd.poll_once() is True
+
+
+def test_watchdog_heartbeat_and_disarm_prevent_fire():
+    clock = FakeClock()
+    wd = HangWatchdog(timeout_s=10.0, clock=clock)
+    wd.arm()
+    clock.now = 8.0
+    wd.arm()  # progress: re-arming is the heartbeat
+    clock.now = 15.0
+    assert wd.poll_once() is False
+    wd.disarm()
+    clock.now = 100.0
+    assert wd.poll_once() is False
+    assert wd.fired == 0
+
+
+def test_watchdog_abort_uses_exit_code():
+    clock = FakeClock()
+    codes = []
+    wd = HangWatchdog(timeout_s=1.0, action="abort", exit_code=14,
+                      clock=clock, abort_fn=codes.append)
+    wd.arm()
+    clock.now = 2.0
+    assert wd.poll_once() is True
+    assert codes == [14]
+    # abort clears the deadline (the process would be gone)
+    clock.now = 50.0
+    assert wd.poll_once() is False
+
+
+def test_watchdog_rejects_unknown_action():
+    with pytest.raises(ValueError, match="warn"):
+        HangWatchdog(timeout_s=1.0, action="explode")
+
+
+# ---------------------------------------------------------------------------
+# dataloader state + reseed (rollback re-entry data order)
+# ---------------------------------------------------------------------------
+def _first_batch_ids(loader):
+    return np.asarray(next(iter(loader))["x"])[:, 0]
+
+
+def test_dataloader_state_dict_roundtrip_restores_order():
+    data = random_dataset(32)
+    src = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=7)
+    src.set_epoch(3)
+    state = src.state_dict()
+    assert state == {"epoch": 3, "seed": 7}
+
+    dst = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=0)
+    dst.load_state_dict(state)
+    np.testing.assert_array_equal(_first_batch_ids(src),
+                                  _first_batch_ids(dst))
+
+
+def test_reseed_changes_order_and_restarts_repeating_loader():
+    data = random_dataset(32)
+    loader = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=0)
+    rep = iter(RepeatingLoader(loader))
+    next(rep)
+    loader.reseed(1)
+    assert loader.seed == 1 and loader.order_version == 1
+    # the in-flight iterator restarts: the next batch is the FIRST batch
+    # of a fresh epoch under the new seed, not the old order's second
+    expected = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=1)
+    np.testing.assert_array_equal(
+        np.asarray(next(rep)["x"])[:, 0], _first_batch_ids(expected))
+
+
+def test_repeating_loader_delegates_state_dict():
+    loader = DeepSpeedDataLoader(random_dataset(32), batch_size=4,
+                                 shuffle=True, seed=2)
+    rep = RepeatingLoader(loader)
+    assert rep.state_dict() == {"epoch": 0, "seed": 2}
+    rep.load_state_dict({"epoch": 5, "seed": 9})
+    assert loader.epoch == 5 and loader.seed == 9
+
+
+# ---------------------------------------------------------------------------
+# monitor: batched CSV writes, MonitorMaster.close
+# ---------------------------------------------------------------------------
+def test_csv_monitor_opens_each_tag_once_per_batch(tmp_path, monkeypatch):
+    from deepspeed_tpu.monitor.monitor import CsvMonitor
+
+    mon = CsvMonitor(CsvConfig.from_dict(
+        {"enabled": True, "output_path": str(tmp_path), "job_name": "j"}))
+    opens = []
+    real_open = builtins.open
+
+    def counting_open(file, mode="r", *args, **kwargs):
+        if str(file).endswith(".csv"):
+            opens.append(str(file))
+        return real_open(file, mode, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+    mon.write_events([("Sentinel/skips", float(i), i) for i in range(5)]
+                     + [("Sentinel/rollbacks", 1.0, 5)])
+    assert len(opens) == 2  # one open per tag, not per event
+    rows = (tmp_path / "j" / "Sentinel_skips.csv").read_text().splitlines()
+    assert len(rows) == 6  # header + 5 events
+    assert rows[-1] == "4,4.0"
+
+
+def test_monitor_master_close_disables_and_is_idempotent(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "j"}})
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    master.write_events([("Train/loss", 1.0, 1)])
+    master.close()
+    assert not master.enabled
+    assert master.csv_monitor.log_dir is None  # backend released
+    master.close()  # idempotent
+    before = (tmp_path / "j" / "Train_loss.csv").read_text()
+    master.csv_monitor.write_events([("Train/loss", 2.0, 2)])  # no-op
+    assert (tmp_path / "j" / "Train_loss.csv").read_text() == before
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end chaos (virtual CPU mesh)
+# ---------------------------------------------------------------------------
+def base_config(**overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def make_engine(config):
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=8), config=config,
+        training_data=random_dataset(64),
+    )
+    return engine, loader, iter(RepeatingLoader(loader))
+
+
+def test_nan_chaos_bounded_skips_rollback_recover(eight_devices, tmp_path):
+    """Acceptance: NaN loss injected at step N → bounded batch skips →
+    automatic rollback to the newest manifest-valid tag → training
+    continues past N with a different data order, all visible in the
+    ``Sentinel/*`` monitor counters."""
+    ckpt = tmp_path / "ckpt"
+    logs = tmp_path / "logs"
+    cfg = base_config(
+        sentinel={"enabled": True, "window": 8, "min_window": 4,
+                  "skip_budget": 2, "rollback_budget": 1,
+                  "rollback_dir": str(ckpt)},
+        csv_monitor={"enabled": True, "output_path": str(logs),
+                     "job_name": "sn"})
+    engine, loader, it = make_engine(cfg)
+    for _ in range(3):
+        engine.train_batch(it)
+    engine.save_checkpoint(str(ckpt))
+    assert cm.latest_valid_tag(str(ckpt)) == "global_step3"
+    seed_before, version_before = loader.seed, loader.order_version
+
+    with fi.nan_at_step(engine, step=3, times=3) as inj:
+        for _ in range(2):
+            engine.train_batch(it)
+        # the in-graph cond actually skipped both poisoned updates
+        assert engine.skipped_steps == 2
+        for _ in range(4):
+            engine.train_batch(it)
+    assert inj.injected == 3
+
+    stats = engine.sentinel.stats
+    # bounded skips: skip_budget (2) consecutive skipped batches, then the
+    # third anomalous step triggers the rollback
+    assert stats["nonfinite_steps"] == 3
+    assert stats["batch_skips"] == 3
+    assert stats["rollbacks"] == 1
+    assert stats["divergences"] == 0
+    # load_checkpoint restored the saved counters (nothing skipped at save)
+    assert engine.skipped_steps == 0
+    # rolled back TO step 3, then continued past it on clean data
+    assert engine.global_steps == 6
+    assert np.isfinite(float(engine._last_loss))
+    # re-entry uses a different data order (reseed + iterator restart)
+    assert loader.seed != seed_before
+    assert loader.order_version > version_before
+
+    log_dir = logs / "sn"
+    skips = (log_dir / "Sentinel_batch_skips.csv").read_text()
+    rollbacks = (log_dir / "Sentinel_rollbacks.csv").read_text()
+    assert skips.strip().splitlines()[-1].endswith("3.0")
+    assert rollbacks.strip().splitlines()[-1].endswith("1.0")
+
+
+def test_rollback_budget_exhaustion_raises_divergence(eight_devices,
+                                                      tmp_path):
+    """Persistent NaNs: one rollback is allowed, then DivergenceError
+    with the configured exit code."""
+    ckpt = tmp_path / "ckpt"
+    cfg = base_config(
+        sentinel={"enabled": True, "skip_budget": 1, "rollback_budget": 1,
+                  "rollback_dir": str(ckpt)})
+    engine, loader, it = make_engine(cfg)
+    engine.train_batch(it)
+    engine.save_checkpoint(str(ckpt))
+    with fi.nan_at_step(engine, step=1, times=None):  # never recovers
+        with pytest.raises(DivergenceError) as ei:
+            for _ in range(10):
+                engine.train_batch(it)
+    assert ei.value.exit_code == 13
+    assert engine.sentinel.stats["rollbacks"] == 1
+    assert engine.sentinel.stats["divergences"] == 1
+
+
+def test_no_rollback_checkpoint_escalates_to_divergence(eight_devices,
+                                                        tmp_path):
+    """skip budget exhausted but nothing to roll back to (no rollback_dir)
+    → DivergenceError instead of a wedged retry loop."""
+    cfg = base_config(sentinel={"enabled": True, "skip_budget": 1,
+                                "rollback_budget": 2})
+    engine, loader, it = make_engine(cfg)
+    engine.train_batch(it)
+    with fi.nan_at_step(engine, step=1, times=None):
+        with pytest.raises(DivergenceError, match="rollback_dir"):
+            for _ in range(10):
+                engine.train_batch(it)
+
+
+def test_spike_injection_trips_loss_spike_counter(eight_devices, tmp_path):
+    cfg = base_config(
+        sentinel={"enabled": True, "window": 8, "min_window": 3,
+                  "loss_spike_ratio": 3.0, "skip_budget": 50,
+                  "rollback_budget": 0})
+    engine, loader, it = make_engine(cfg)
+    for _ in range(5):
+        engine.train_batch(it)
+    assert engine.sentinel.stats["loss_spikes"] == 0
+    with fi.spike_at_step(engine, step=5, scale=100.0, times=1) as inj:
+        engine.train_batch(it)
+    assert inj.injected == 1
+    assert engine.sentinel.stats["loss_spikes"] == 1
+
+
+def test_hang_watchdog_fires_on_stalled_engine_step(eight_devices,
+                                                    tmp_path):
+    cfg = base_config(
+        sentinel={"enabled": True, "hang_timeout_s": 0.15,
+                  "hang_action": "warn"})
+    engine, loader, it = make_engine(cfg)
+    engine.train_batch(it)  # compiles (watchdog deliberately disarmed)
+    engine.train_batch(it)
+    # a loaded CI box can stretch even a healthy CPU step past a timeout
+    # this short, so assert the hang ADDS fires rather than fires == 0
+    fires_before = engine.sentinel.stats["watchdog_fires"]
+    with fi.hang_at_step(engine, step=2, seconds=0.6) as inj:
+        engine.train_batch(it)  # stalls mid-step with the watchdog armed
+    assert inj.injected == 1
+    assert engine.sentinel.stats["watchdog_fires"] > fires_before
+    assert engine._watchdog.last_dump is not None
+    # warn mode: training continues
+    engine.train_batch(it)
+    engine._watchdog.stop()
+
+
+def test_checkpoint_carries_dataloader_state(eight_devices, tmp_path):
+    cfg = base_config()
+    engine, loader, it = make_engine(cfg)
+    engine.train_batch(it)
+    loader.set_epoch(4)
+    loader.seed = 11
+    engine.save_checkpoint(str(tmp_path))
+
+    engine2, loader2, it2 = make_engine(cfg)
+    engine2.train_batch(it2)
+    engine2.load_checkpoint(str(tmp_path))
+    assert loader2.epoch == 4 and loader2.seed == 11
+
+
+# ---------------------------------------------------------------------------
+# elastic agent: divergence exit code is terminal, not restartable
+# ---------------------------------------------------------------------------
+def _write_worker(tmp_path, body) -> str:
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(body))
+    return str(worker)
+
+
+def test_elastic_agent_does_not_restart_on_divergence(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    worker = _write_worker(tmp_path, "import sys; sys.exit(13)")
+    agent = DSElasticAgent([sys.executable, worker], {},
+                           discover_world=lambda: 1, max_restarts=5,
+                           backoff_s=0.0, jitter=0.0)
+    assert agent.run() == 13
+    assert agent.restart_count == 0  # not one restart was burned
+
+
+def test_elastic_agent_still_restarts_on_ordinary_crash(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    marker = tmp_path / "attempts"
+    worker = _write_worker(tmp_path, f"""
+        import os, sys
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        sys.exit(0 if n >= 1 else 14)  # hang-abort code: restartable
+    """)
+    agent = DSElasticAgent([sys.executable, worker], {},
+                           discover_world=lambda: 1, max_restarts=3,
+                           backoff_s=0.0, jitter=0.0)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+
+
+def test_elastic_agent_custom_divergence_codes(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    worker = _write_worker(tmp_path, "import sys; sys.exit(42)")
+    agent = DSElasticAgent([sys.executable, worker], {},
+                           discover_world=lambda: 1, max_restarts=5,
+                           backoff_s=0.0, jitter=0.0,
+                           divergence_exit_codes=(42,))
+    assert agent.run() == 42
+    assert agent.restart_count == 0
