@@ -11,6 +11,10 @@
 #                preemption grace saves, crash-loop detection, and the
 #                training health sentinel: NaN/spike anomalies, auto-
 #                rollback, hang watchdog (docs/recovery.md)
+#   make profile step-profiler gate on a tiny CPU config: asserts phase
+#                breakdown sums to step wall time, analytic MFU from the
+#                compiled step, and a perfetto-loadable trace
+#                (docs/observability.md)
 #   make check   test + smoke-if-hot-paths-changed — the full gate
 #   make hooks   install the committed .githooks (pre-push runs
 #                `make quick` + conditional smoke)
@@ -22,7 +26,7 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/ops deepspeed_tpu/utils/timer.py \
              deepspeed_tpu/inference/engine.py
 
-.PHONY: quick test smoke chaos check hooks hot-changed
+.PHONY: quick test smoke chaos profile check hooks hot-changed
 
 quick:
 	$(PY) -c "import deepspeed_tpu; import __graft_entry__; print('imports ok')"
@@ -37,6 +41,9 @@ smoke:
 
 chaos:
 	$(PY) -m pytest tests/unit/test_fault_tolerance.py tests/unit/test_sentinel.py -q
+
+profile:
+	$(PY) benchmarks/profile_step.py
 
 # exits 0 when any hot-path file differs from BASE (override: `make
 # hot-changed BASE=<sha>` — the pre-push hook passes the remote sha so a

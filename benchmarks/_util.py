@@ -1,5 +1,8 @@
 """Shared benchmark helpers."""
 
+import json
+import subprocess
+import sys
 import time
 
 from deepspeed_tpu.utils.timer import fence  # noqa: F401  (re-export)
@@ -29,5 +32,106 @@ def time_train_steps(engine, batch, steps: int = 5,
         engine.train_batch(it)
     fence(engine.params)
     return (time.time() - t0) / steps
+
+
+def analytic_step_metrics(engine, dt: float, peak: float = None) -> dict:
+    """Compiled-step cost-analysis metrics for one optimizer step.
+
+    Complements the hand-derived ``model_tflops`` (algorithmic 6N count)
+    with what XLA actually scheduled: ``analytic_tflops`` from the
+    compiled program's HLO flops (per device, post-partitioning) over the
+    measured step time, and MFU against the hardware-peak table
+    (``profiling/step_profiler.py``). Returns {} when the engine has no
+    compiled step yet or the backend exposes no cost model — callers
+    merge it without caring."""
+    try:
+        cost = engine.compiled_step_cost()
+    except Exception:
+        cost = None
+    if not cost or not cost.get("flops"):
+        return {}
+    from deepspeed_tpu.profiling.step_profiler import peak_tflops
+
+    src = "caller"
+    if peak is None:
+        peak, src = peak_tflops()
+    tflops = cost["flops"] / dt / 1e12
+    return {
+        "analytic_flops_per_step": cost["flops"],
+        "analytic_tflops": round(tflops, 2),
+        "analytic_mfu": round(tflops / peak, 4) if peak else 0.0,
+        "analytic_peak_tflops": peak,
+        "analytic_peak_source": src,
+        "hbm_gb_per_s": round(cost.get("bytes_accessed", 0.0) / dt / 1e9, 1),
+    }
+
+
+def backend_preflight(max_tries: int = 2, backoff_s: float = 10.0,
+                      emit=None, _runner=None) -> dict:
+    """Probe the accelerator backend in a SUBPROCESS before committing to a
+    benchmark run (ROADMAP item 1: BENCH_r05 died rc=1 on a transient axon
+    init error with zero evidence emitted).
+
+    A subprocess probe is deliberate: a failed in-process ``jax.devices()``
+    poisons the backend state for the whole interpreter, so the retry must
+    happen before THIS process touches jax. Returns
+    ``{"ok": bool, "attempts": n, "backend"| "error": ...}``; each failed
+    attempt is reported through ``emit`` (default: a JSON line on stdout)
+    so even a hard failure leaves evidence. ``_runner`` injects a fake
+    probe for tests."""
+    emit = emit or (lambda obj: print(json.dumps(obj), flush=True))
+    probe = _runner or _default_backend_probe
+    err = ""
+    for attempt in range(1, max_tries + 1):
+        try:
+            ok, detail = probe()
+        except Exception as e:  # a broken probe is a failed attempt
+            ok, detail = False, f"{type(e).__name__}: {e}"
+        if ok:
+            return {"ok": True, "attempts": attempt, "backend": detail}
+        err = detail
+        emit({"event": "backend_preflight_failure", "attempt": attempt,
+              "max_tries": max_tries, "error": str(detail)[-2000:]})
+        if attempt < max_tries:
+            time.sleep(backoff_s)
+    return {"ok": False, "attempts": max_tries, "error": str(err)[-2000:]}
+
+
+def _default_backend_probe(timeout_s: float = 120.0):
+    code = ("import jax; d = jax.devices(); "
+            "print(jax.default_backend(), len(d))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe timed out after {timeout_s}s"
+    if r.returncode == 0:
+        return True, r.stdout.strip()
+    tail = (r.stderr or r.stdout or "").strip()
+    return False, f"rc={r.returncode}: {tail[-1500:]}"
+
+
+def run_with_retry(fn, name: str, retries: int = 1, backoff_s: float = 5.0,
+                   emit=None):
+    """Run ``fn()``; on failure emit an evidence JSON line, back off, and
+    retry up to ``retries`` more times. Returns ``(result, None)`` or
+    ``(None, error_str)`` — never raises, so one flaky workload cannot
+    turn the whole bench into an evidence-free rc=1."""
+    emit = emit or (lambda obj: print(json.dumps(obj), flush=True))
+    err = ""
+    for attempt in range(1, retries + 2):
+        try:
+            return fn(), None
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            emit({"event": "workload_failure", "workload": name,
+                  "attempt": attempt, "max_attempts": retries + 1,
+                  "error": err[-2000:]})
+            if attempt <= retries:
+                import gc
+
+                gc.collect()
+                time.sleep(backoff_s)
+    return None, err
 
 

@@ -75,7 +75,7 @@ def run(model_name: str = "bert-large", seq: int = 128, micro: int = 64,
     attn = 12 * L * C * seq  # bidirectional attention, fwd+bwd
     flops_per_token = 6.0 * n_nonembed + attn
     tokens = gb * seq
-    return {
+    out = {
         "model": model_name, "seq": seq, "global_batch": gb,
         "n_devices": n_dev,
         "samples_per_sec": round(gb / dt / n_dev, 1),
@@ -83,6 +83,10 @@ def run(model_name: str = "bert-large", seq: int = 128, micro: int = 64,
         "model_tflops": round(tokens * flops_per_token / dt / 1e12 / n_dev,
                               2),
     }
+    from benchmarks._util import analytic_step_metrics
+
+    out.update(analytic_step_metrics(engine, dt))
+    return out
 
 
 def main():

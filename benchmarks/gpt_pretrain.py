@@ -24,7 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
-from benchmarks._util import gpt_flops_per_token, time_train_steps
+from benchmarks._util import (
+    analytic_step_metrics,
+    gpt_flops_per_token,
+    time_train_steps,
+)
 from deepspeed_tpu.models.transformer_lm import GPT, gpt2_config, num_params
 
 BASELINE_TFLOPS = 30.0  # ZeRO-Offload, 1x V100: docs/_pages/training.md:293
@@ -66,7 +70,7 @@ def run(model_name="gpt2-1.3b", seq=1024, micro=6, steps=6,
     n_params = num_params(cfg)
     fpt = gpt_flops_per_token(cfg, seq)
     n_dev = len(jax.devices())
-    return {
+    out = {
         "model": model_name,
         "n_params": n_params,
         "model_tflops": round(gb * seq * fpt / dt / 1e12 / n_dev, 2),
@@ -76,6 +80,10 @@ def run(model_name="gpt2-1.3b", seq=1024, micro=6, steps=6,
         "global_batch": gb,
         "n_devices": n_dev,
     }
+    # what XLA actually scheduled (includes remat recompute the 6N count
+    # deliberately excludes) — analytic_mfu is the hardware-honest number
+    out.update(analytic_step_metrics(engine, dt))
+    return out
 
 
 if __name__ == "__main__":

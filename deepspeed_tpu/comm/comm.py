@@ -51,9 +51,19 @@ class ReduceOp:
 # ---------------------------------------------------------------------------
 # In-program collectives (use inside shard_map / jit with named axes)
 # ---------------------------------------------------------------------------
+def _axis_world(axis: str):
+    """Static size of a bound mesh axis at trace time (``psum(1, axis)`` is
+    constant-folded to the axis size), or None when called with the axis
+    unbound — the comms logger then falls back to payload-only accounting."""
+    try:
+        return int(lax.psum(1, axis))
+    except Exception:
+        return None
+
+
 def all_reduce(x, axis: str, op: str = ReduceOp.SUM):
     """reference comm/comm.py:503 all_reduce."""
-    comms_logger.append("all_reduce", x, axis)
+    comms_logger.append("all_reduce", x, axis, world=_axis_world(axis))
     if op == ReduceOp.SUM:
         return lax.psum(x, axis)
     if op == ReduceOp.AVG:
@@ -73,19 +83,19 @@ def all_reduce(x, axis: str, op: str = ReduceOp.SUM):
 def all_gather(x, axis: str, gather_dim: int = 0, tiled: bool = True):
     """reference comm/comm.py all_gather/_base; tiled=True concatenates along
     ``gather_dim`` (the _base flat-buffer form)."""
-    comms_logger.append("all_gather", x, axis)
+    comms_logger.append("all_gather", x, axis, world=_axis_world(axis))
     return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str, scatter_dim: int = 0):
     """reference comm/comm.py reduce_scatter(_base) → psum_scatter."""
-    comms_logger.append("reduce_scatter", x, axis)
+    comms_logger.append("reduce_scatter", x, axis, world=_axis_world(axis))
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
 
 
 def all_to_all_single(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
     """reference comm/comm.py:392 all_to_all_single (MoE dispatch path)."""
-    comms_logger.append("all_to_all", x, axis)
+    comms_logger.append("all_to_all", x, axis, world=_axis_world(axis))
     return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
                           tiled=True)
 
@@ -93,7 +103,7 @@ def all_to_all_single(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
 def ppermute(x, axis: str, perm):
     """Point-to-point ring/pipeline transfer (reference pipe/p2p.py send/recv
     :48-161 collapses to one collective-permute on TPU)."""
-    comms_logger.append("ppermute", x, axis)
+    comms_logger.append("ppermute", x, axis, world=_axis_world(axis))
     return lax.ppermute(x, axis, perm)
 
 
@@ -111,7 +121,7 @@ def send_recv_prev(x, axis: str, axis_size: int):
 
 def broadcast(x, axis: str, root: int = 0):
     """reference comm/comm.py:223 broadcast: every rank gets root's value."""
-    comms_logger.append("broadcast", x, axis)
+    comms_logger.append("broadcast", x, axis, world=_axis_world(axis))
     idx = lax.axis_index(axis)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
     return lax.psum(masked, axis)

@@ -27,6 +27,7 @@ from typing import Tuple, Union
 import jax.numpy as jnp
 from jax import lax
 
+from deepspeed_tpu.comm.logging import comms_logger
 from deepspeed_tpu.ops.quantizer import dequantize, quantize
 
 
@@ -70,7 +71,7 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     ``(out, worker_err, new_server_error)``. Without it, phase-2
     requantization noise (~1/127 relative per step) goes uncompensated.
     """
-    w = lax.axis_size(axis)
+    w = int(lax.psum(1, axis))  # static axis size at trace time
     shape, dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).ravel()
     n = flat.size
@@ -82,6 +83,14 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     # phase 1: quantize full tensor, all_to_all so rank r holds every
     # rank's int8 copy of shard r
     q, s = _quantize_blocks(flat, block)
+    # trace-time wire accounting: the int8 payload and its fp32 per-block
+    # scale sideband are what actually cross the interconnect (the logical
+    # tensor never does) — log both under distinct names so the comm
+    # benchmarks can report payload vs sideband
+    comms_logger.append("all_to_all", q, axis,
+                        log_name="quantized_all_reduce", world=w)
+    comms_logger.append("all_to_all", s, axis,
+                        log_name="quantized_all_reduce.scales", world=w)
     q_recv = lax.all_to_all(q.reshape(w, per), axis,
                             split_axis=0, concat_axis=0, tiled=False)
     s_recv = lax.all_to_all(s.reshape(w, per // block), axis,
@@ -95,6 +104,10 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
 
     # phase 2: re-quantize the reduced shard, all_gather, dequantize
     q2, s2 = _quantize_blocks(reduced, block)
+    comms_logger.append("all_gather", q2, axis,
+                        log_name="quantized_all_reduce", world=w)
+    comms_logger.append("all_gather", s2, axis,
+                        log_name="quantized_all_reduce.scales", world=w)
     q_all = lax.all_gather(q2, axis, tiled=True)      # [W * per]
     s_all = lax.all_gather(s2, axis, tiled=True)      # [W * per/block]
     out = dequantize(q_all, s_all)

@@ -24,6 +24,43 @@ def _nbytes(x) -> int:
         return 0
 
 
+def _nelems(x) -> int:
+    try:
+        return int(np.prod(x.shape))
+    except Exception:
+        return 0
+
+
+def wire_factor(op_name: str, world: Optional[int]) -> float:
+    """Bytes sent per device over the interconnect, as a multiple of the
+    op's INPUT payload, under the standard ring accounting the comm
+    benchmarks already use (``benchmarks/communication/run_all.py``
+    busbw factors):
+
+    - ``all_reduce``: 2(w-1)/w  (reduce-scatter + all-gather rounds)
+    - ``reduce_scatter`` / ``all_to_all``: (w-1)/w of the full input
+    - ``all_gather``: (w-1) x the local shard (the input here IS the shard)
+    - ``broadcast``: lowered as masked psum in ``comm.py`` → allreduce cost
+    - ``ppermute``: every device forwards its full payload once
+
+    ``world=None`` (axis size unknown at the call site) conservatively
+    charges the full payload; ``world=1`` is free — nothing crosses a wire.
+    """
+    if world is None:
+        return 1.0
+    w = int(world)
+    if w <= 1:
+        return 0.0
+    base = op_name.split(".")[0]
+    if base in ("all_reduce", "broadcast"):
+        return 2.0 * (w - 1) / w
+    if base in ("reduce_scatter", "all_to_all"):
+        return (w - 1) / w
+    if base == "all_gather":
+        return float(w - 1)
+    return 1.0  # ppermute / unknown: payload crosses once
+
+
 class CommsLogger:
     def __init__(self, enabled: bool = False, verbose: bool = False,
                  prof_all: bool = True, prof_ops=None, debug: bool = False):
@@ -33,9 +70,12 @@ class CommsLogger:
         self.prof_ops = prof_ops or []
         self.debug = debug
         self._lock = threading.Lock()
-        # op name -> {"count": int, "bytes": int, "msg_sizes": {size: count}}
+        # op name -> {"count", "bytes" (logical payload), "wire_bytes"
+        # (ring-accounted bytes sent per device in the WIRE dtype),
+        # "wire_dtype", "msg_sizes": {size: count}}
         self.comms_dict: Dict[str, Dict] = defaultdict(
-            lambda: {"count": 0, "bytes": 0, "msg_sizes": defaultdict(int)}
+            lambda: {"count": 0, "bytes": 0, "wire_bytes": 0,
+                     "wire_dtype": None, "msg_sizes": defaultdict(int)}
         )
 
     def configure(self, config) -> None:
@@ -50,28 +90,74 @@ class CommsLogger:
             return False
         return self.prof_all or op_name in self.prof_ops
 
-    def append(self, op_name: str, tensor, axis: Optional[str], log_name: Optional[str] = None) -> None:
-        """Record one collective at trace time."""
+    def append(self, op_name: str, tensor, axis: Optional[str],
+               log_name: Optional[str] = None, wire_dtype=None,
+               world: Optional[int] = None) -> None:
+        """Record one collective at trace time.
+
+        ``bytes`` counts the logical input payload in the tensor's own
+        dtype (reference CommsLogger behaviour). ``wire_bytes`` is the new
+        on-the-wire estimate: the payload re-expressed in ``wire_dtype``
+        (what actually crosses the interconnect — int8 for the quantized
+        path) scaled by :func:`wire_factor` for the op's ring cost at axis
+        size ``world``.
+        """
         name = log_name or op_name
         if not self._should_log(name):
             return
         size = _nbytes(tensor)
+        if wire_dtype is not None:
+            try:
+                wire_payload = _nelems(tensor) * np.dtype(wire_dtype).itemsize
+            except Exception:
+                wire_payload = size
+        else:
+            wire_payload = size
+        wire = int(round(wire_payload * wire_factor(op_name, world)))
         with self._lock:
             rec = self.comms_dict[name]
             rec["count"] += 1
             rec["bytes"] += size
+            rec["wire_bytes"] += wire
+            if wire_dtype is not None:
+                rec["wire_dtype"] = str(np.dtype(wire_dtype))
             rec["msg_sizes"][size] += 1
         if self.verbose:
             log_dist(
-                f"comm op: {name} | axis: {axis} | msg size: {size} bytes",
+                f"comm op: {name} | axis: {axis} | msg size: {size} bytes"
+                f" | wire: {wire} bytes",
                 ranks=[0],
             )
 
-    def log_summary(self) -> str:
-        lines = ["Comm. Op            Count    Total Bytes"]
+    def counters(self) -> Dict[str, float]:
+        """Flat cumulative counters for ``Monitor`` export (``Comm/*``):
+        per-op ``<name>_count`` / ``<name>_bytes`` / ``<name>_wire_bytes``
+        plus ``total_wire_bytes``. Trace-time semantics: these grow per
+        *trace*, not per executed step (see module docstring)."""
+        out: Dict[str, float] = {}
+        total_wire = 0
         with self._lock:
             for name, rec in sorted(self.comms_dict.items()):
-                lines.append(f"{name:<20}{rec['count']:<9}{rec['bytes']}")
+                key = name.replace("/", "_").replace(" ", "_")
+                out[f"{key}_count"] = float(rec["count"])
+                out[f"{key}_bytes"] = float(rec["bytes"])
+                out[f"{key}_wire_bytes"] = float(rec["wire_bytes"])
+                total_wire += rec["wire_bytes"]
+        out["total_wire_bytes"] = float(total_wire)
+        return out
+
+    def total_wire_bytes(self) -> int:
+        with self._lock:
+            return sum(rec["wire_bytes"] for rec in self.comms_dict.values())
+
+    def log_summary(self) -> str:
+        lines = ["Comm. Op            Count    Total Bytes    Wire Bytes"]
+        with self._lock:
+            for name, rec in sorted(self.comms_dict.items()):
+                wire = rec["wire_bytes"]
+                dt = f" ({rec['wire_dtype']})" if rec["wire_dtype"] else ""
+                lines.append(f"{name:<20}{rec['count']:<9}{rec['bytes']:<15}"
+                             f"{wire}{dt}")
                 for size, cnt in sorted(rec["msg_sizes"].items()):
                     lines.append(f"    msg size {size:>12} B  x{cnt}")
         summary = "\n".join(lines)

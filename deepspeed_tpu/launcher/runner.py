@@ -185,6 +185,24 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _user_script_index(raw, user_script, user_args) -> int:
+    """Index of the user script in the original runner argv.
+
+    ``user_args`` is ``nargs=REMAINDER``, so the script sits exactly at
+    ``len(raw) - len(user_args) - 1``. A plain ``raw.index(user_script)``
+    (first occurrence) truncates the runner's own options when one of
+    their VALUES equals the script path (e.g. ``--include train.py``
+    typo'd before the real ``train.py``); a last-occurrence search fails
+    the mirror case where the script name recurs inside ``user_args``.
+    The arithmetic split is exact for both; the rindex fallback only
+    covers argv lists that didn't come from ``parse_args`` verbatim.
+    """
+    at = len(raw) - len(user_args) - 1
+    if 0 <= at < len(raw) and raw[at] == user_script:
+        return at
+    return len(raw) - 1 - raw[::-1].index(user_script)
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
 
@@ -220,7 +238,8 @@ def main(argv=None) -> int:
                 # strip --autotuning in every argparse spelling (exact,
                 # '=value', prefix abbreviation) — but only among the
                 # RUNNER's options, i.e. tokens before the user script
-                script_at = raw.index(args.user_script)
+                script_at = _user_script_index(raw, args.user_script,
+                                               args.user_args)
                 kept, skip = [], False
                 for j, tok in enumerate(raw[:script_at]):
                     if skip:

@@ -175,6 +175,13 @@ class MonitorMaster(Monitor):
             if m is not None:
                 m.write_events(event_list)
 
+    def write_counters(self, prefix: str, counters, step: int):
+        """Export a dict of cumulative counters as ``prefix/name`` scalars
+        — the ``Perf/*`` / ``Comm/*`` convention the step profiler and
+        comms logger use (profiling/step_profiler.py ``finalize``)."""
+        if counters:
+            self.write_events(counter_events(prefix, counters, step))
+
     def close(self):
         """Flush/close every backend (graceful-shutdown path). Idempotent;
         later ``write_events`` calls become no-ops."""

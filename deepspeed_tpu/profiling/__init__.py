@@ -1,1 +1,5 @@
 from deepspeed_tpu.profiling import flops_profiler  # noqa: F401
+from deepspeed_tpu.profiling.step_profiler import (  # noqa: F401
+    StepProfiler,
+    peak_tflops,
+)

@@ -40,6 +40,8 @@ def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
     compiled = jitted.lower(*args, **kwargs).compile()
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):  # older jax: one dict per computation
+        ca = ca[0] if ca else {}
     return {
         "flops": _num(ca.get("flops", 0)),
         "bytes_accessed": _num(ca.get("bytes accessed", 0)),

@@ -213,6 +213,33 @@ class CommsLoggerConfig(ConfigModel):
 
 
 @dataclass
+class StepProfilerConfig(ConfigModel):
+    """Step-level performance tracer (docs/observability.md). Profiles the
+    half-open optimizer-step window ``[start_step, start_step+num_steps)``:
+    fenced per-step phase attribution, compiled-step cost analysis →
+    analytic MFU, Chrome trace-event export, optional ``jax.profiler``
+    capture. Disabled (the default) it adds zero device syncs."""
+
+    enabled: bool = False
+    start_step: int = 2          # skip compile + warmup steps
+    num_steps: int = 8           # window length in optimizer steps
+    trace_path: Optional[str] = None   # Chrome trace JSON ("" / None: off)
+    jax_trace: bool = False            # jax.profiler capture over the window
+    jax_trace_dir: Optional[str] = None
+    peak_tflops: Optional[float] = None  # override the hardware-peak table
+    emit_counters: bool = True           # Perf/* + Comm/* via the monitor
+
+    def __post_init__validate__(self):
+        if self.start_step < 0:
+            raise DeepSpeedConfigError("step_profiler.start_step must be >= 0")
+        if self.num_steps < 1:
+            raise DeepSpeedConfigError("step_profiler.num_steps must be >= 1")
+        if self.jax_trace and not self.jax_trace_dir:
+            raise DeepSpeedConfigError(
+                "step_profiler.jax_trace requires step_profiler.jax_trace_dir")
+
+
+@dataclass
 class CurriculumConfig(ConfigModel):
     enabled: bool = False
     curriculum_type: str = "seqlen"
@@ -473,6 +500,8 @@ class DeepSpeedConfig:
         self.wandb = WandbConfig.from_dict(pd.get(C.MONITOR_WANDB, {}))
         self.csv_monitor = CsvConfig.from_dict(pd.get(C.MONITOR_CSV, {}))
         self.comms_logger = CommsLoggerConfig.from_dict(pd.get(C.COMMS_LOGGER, {}))
+        self.step_profiler = StepProfilerConfig.from_dict(
+            pd.get(C.STEP_PROFILER, {}))
         self.curriculum_learning = CurriculumConfig.from_dict(
             pd.get(C.CURRICULUM_LEARNING, {})
         )

@@ -170,6 +170,7 @@ MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
 COMMS_LOGGER = "comms_logger"
+STEP_PROFILER = "step_profiler"
 AIO = "aio"
 NEBULA = "nebula"
 QUANTIZE_TRAINING = "quantize_training"

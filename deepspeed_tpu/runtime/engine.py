@@ -71,6 +71,12 @@ from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTime
 FORWARD_MICRO_TIMER = "fwd_bwd_microstep"
 STEP_MICRO_TIMER = "step_microstep"
 
+# shared no-op context for `_prof_phase` when the step profiler is off:
+# the healthy path must gain zero device syncs and near-zero host work
+import contextlib as _contextlib
+
+_NULL_PROF_CTX = _contextlib.nullcontext()
+
 
 def initialize(
     args=None,
@@ -387,6 +393,16 @@ class DeepSpeedEngine:
 
         self.monitor = self._configure_monitor()
 
+        # step-level performance tracer (config-gated; docs/observability.md).
+        # None when disabled so the hot path pays one attribute check and
+        # gains zero device syncs.
+        self.step_profiler = None
+        if config.step_profiler.enabled:
+            from deepspeed_tpu.profiling.step_profiler import StepProfiler
+
+            self.step_profiler = StepProfiler(
+                config.step_profiler, timers=self.timers, monitor=self.monitor)
+
         # fault-tolerance telemetry (wall_clock_breakdown-style counters,
         # exported through the monitor as FaultTolerance/* events)
         self.ft_stats = {
@@ -494,6 +510,10 @@ class DeepSpeedEngine:
         self._fwd_bwd_fn = None
         self._apply_fn = None
         self._eval_fn = None
+        # avals of the last device batch (a handful of leaves — cheap to
+        # rebuild per put) so compiled_step_cost() can re-lower the step
+        # without holding live buffers
+        self._last_batch_aval = None
         # write-through param_groups["lr"]: an absolute lr override applied
         # as a multiplicative factor on the compiled step's updates (updates
         # are linear in lr). None = follow the schedule/config.
@@ -1247,11 +1267,72 @@ class DeepSpeedEngine:
                 return jax.device_put(x, self.topology.sharding(*spec))
             return jax.device_put(x, sharding)
 
-        return jax.tree.map(put, batch)
+        device_batch = jax.tree.map(put, batch)
+        self._last_batch_aval = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), device_batch)
+        return device_batch
 
     # ------------------------------------------------------------------
     # train API (reference forward/backward/step protocol)
     # ------------------------------------------------------------------
+    def _prof_phase(self, name: str):
+        """Step-profiler phase context; the shared no-op when profiling is
+        off (one attribute check on the healthy path, no syncs)."""
+        if self.step_profiler is None:
+            return _NULL_PROF_CTX
+        return self.step_profiler.phase(name)
+
+    def _prof_begin_step(self):
+        if self.step_profiler is not None:
+            self.step_profiler.begin_step(self.global_steps)
+
+    def _prof_end_step(self):
+        if self.step_profiler is not None:
+            # counters passed as a callable: only materialized if this
+            # end_step closes the window and exports
+            self.step_profiler.end_step(
+                self.global_steps, comm_counters=comms_logger.counters,
+                cost_cb=self.compiled_step_cost)
+
+    def compiled_step_cost(self) -> Optional[Dict[str, float]]:
+        """XLA cost analysis of one optimizer step's compiled program(s):
+        ``{"flops", "bytes_accessed", "optimal_seconds"}`` per device, or
+        None before the step has compiled. The fused path lowers the
+        single step program; the unfused path charges the fwd/bwd program
+        once per micro step plus the apply program (the honest per-step
+        total). Used by the step profiler and the bench harnesses in
+        place of hand-derived FLOP counts."""
+        from deepspeed_tpu.profiling.flops_profiler.profiler import (
+            cost_analysis)
+
+        aval = partial(jax.tree.map,
+                       lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype))
+        if self._last_batch_aval is None or not self._initialized:
+            return None
+        scale = self._ls_state.scale if self.fp16_enabled else self._unit_scale
+        lr_factor = jnp.float32(1.0)
+        try:
+            if self._train_step_fn is not None:
+                return cost_analysis(
+                    self._train_step_fn, aval(self._params),
+                    aval(self._opt_state), aval(self._ls_state),
+                    self._last_batch_aval, aval(self._rng),
+                    self.micro_steps, lr_factor)
+            if self._fwd_bwd_fn is None or self._apply_fn is None:
+                return None
+            gas = self.gradient_accumulation_steps
+            fwd = cost_analysis(
+                self._fwd_bwd_fn, aval(self._params), aval(self._acc_grads),
+                self._last_batch_aval, aval(self._rng), self.micro_steps,
+                aval(scale))
+            app = cost_analysis(
+                self._apply_fn, aval(self._params), aval(self._opt_state),
+                aval(self._acc_grads), aval(self._ls_state), lr_factor)
+            return {k: fwd[k] * gas + app[k] for k in fwd}
+        except Exception as e:
+            logger.warning(f"compiled_step_cost unavailable: {e}")
+            return None
+
     def forward(self, batch: Dict[str, Any]):
         """Compute loss for one micro batch. Gradients are computed fused with
         the forward (JAX has no separate backward graph) and cached until
@@ -1277,7 +1358,11 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).start()
         self.tput_timer.start()
 
-        device_batch = self._put_batch(batch)
+        # idempotent: train_batch() already opened the step envelope; a
+        # direct forward/backward/step caller opens it here instead
+        self._prof_begin_step()
+        with self._prof_phase("h2d"):
+            device_batch = self._put_batch(batch)
         scale = self._ls_state.scale if self.fp16_enabled else self._unit_scale
 
         # one-shot flops profile at the configured step (reference
@@ -1300,10 +1385,11 @@ class DeepSpeedEngine:
         # grads accumulate eagerly (the donated buffer is consumed here);
         # backward() is the protocol-parity bookkeeping step
         prev_pending = self._pending_grad_leaves
-        self._acc_grads, loss = self._fwd_bwd_fn(
-            self._params, self._acc_grads, device_batch, self._rng,
-            self.micro_steps, scale
-        )
+        with self._prof_phase("compiled_step"):
+            self._acc_grads, loss = self._fwd_bwd_fn(
+                self._params, self._acc_grads, device_batch, self._rng,
+                self.micro_steps, scale
+            )
         if (self._offload_param_device != "none"
                 and self.gradient_accumulation_steps > 1):
             # streamed-param mode replaces the grad tree each micro step;
@@ -1401,17 +1487,19 @@ class DeepSpeedEngine:
             if self.wall_clock_breakdown:
                 self.timers(STEP_MICRO_TIMER).start()
             if self._offload_opt is not None:
-                overflow = self._take_offload_step()
+                with self._prof_phase("compiled_step"):
+                    overflow = self._take_offload_step()
             else:
                 if self._apply_fn is None:
                     self._apply_fn = self._build_apply()
-                (
-                    self._params, self._opt_state, self._acc_grads,
-                    self._ls_state, overflow, grad_norm,
-                ) = self._apply_fn(
-                    self._params, self._opt_state, self._acc_grads,
-                    self._ls_state, self._lr_factor_now()
-                )
+                with self._prof_phase("compiled_step"):
+                    (
+                        self._params, self._opt_state, self._acc_grads,
+                        self._ls_state, overflow, grad_norm,
+                    ) = self._apply_fn(
+                        self._params, self._opt_state, self._acc_grads,
+                        self._ls_state, self._lr_factor_now()
+                    )
                 # gate short-circuit first: bool(overflow) on the device
                 # scalar would force a host sync every step when neither
                 # fp16 nor the sentinel's non-finite guard is on
@@ -1425,6 +1513,7 @@ class DeepSpeedEngine:
             if self.wall_clock_breakdown:
                 self.timers(STEP_MICRO_TIMER).stop()
                 self.timers.log([FORWARD_MICRO_TIMER, STEP_MICRO_TIMER])
+            self._prof_end_step()
         finally:
             # the step boundary is the heartbeat's end, even when the
             # bookkeeping raised (DivergenceError must not leave the
@@ -1517,7 +1606,8 @@ class DeepSpeedEngine:
                   self.global_samples)]
             )
         if self.sentinel is not None:
-            self._sentinel_observe(update_skipped, step_losses)
+            with self._prof_phase("sentinel"):
+                self._sentinel_observe(update_skipped, step_losses)
         if self._preempt_signum is not None:
             self._graceful_shutdown()
 
@@ -1537,14 +1627,20 @@ class DeepSpeedEngine:
         (PipelineEngine.train_batch parity, pipe/engine.py:296). Returns the
         mean micro loss. With gas == 1 the whole step runs as one fused
         compiled program (fwd+bwd+optimizer)."""
+        # the step envelope opens before the dataloader pull so input-bound
+        # steps show up as a fat `dataloader` phase, not missing time
+        self._prof_begin_step()
         if (self.gradient_accumulation_steps == 1
                 and not self._config.flops_profiler.enabled
                 and not self.wall_clock_breakdown
                 and self._offload_device == "none"):
-            return self._train_batch_fused(next(data_iter))
+            with self._prof_phase("dataloader"):
+                batch = next(data_iter)
+            return self._train_batch_fused(batch)
         losses = []
         for _ in range(self.gradient_accumulation_steps):
-            batch = next(data_iter)
+            with self._prof_phase("dataloader"):
+                batch = next(data_iter)
             loss = self.forward(batch)
             self.backward()
             losses.append(loss)
@@ -1571,11 +1667,14 @@ class DeepSpeedEngine:
             self._watchdog.arm()
         try:
             self.tput_timer.start()
-            device_batch = self._put_batch(batch)
-            (self._params, self._opt_state, self._ls_state, loss, overflow,
-             grad_norm) = self._train_step_fn(
-                self._params, self._opt_state, self._ls_state, device_batch,
-                self._rng, self.micro_steps, self._lr_factor_now())
+            self._prof_begin_step()
+            with self._prof_phase("h2d"):
+                device_batch = self._put_batch(batch)
+            with self._prof_phase("compiled_step"):
+                (self._params, self._opt_state, self._ls_state, loss, overflow,
+                 grad_norm) = self._train_step_fn(
+                    self._params, self._opt_state, self._ls_state, device_batch,
+                    self._rng, self.micro_steps, self._lr_factor_now())
             if (self._compressed_mode is None
                     or self._compressed_norm_available) and not (
                     self._check_overflow and bool(overflow)):
@@ -1589,6 +1688,7 @@ class DeepSpeedEngine:
 
             self._post_step_bookkeeping(overflow, [loss])
             self.tput_timer.stop(global_step=True)
+            self._prof_end_step()
             return loss
         finally:
             if self._watchdog is not None:
@@ -1897,6 +1997,15 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        # thin wrapper so a mid-training save (graceful shutdown, periodic
+        # checkpointing inside the profiled window) is attributed to the
+        # `checkpoint` phase; a no-op context when profiling is off
+        with self._prof_phase("checkpoint"):
+            return self._save_checkpoint_impl(save_dir, tag, client_state,
+                                              save_latest)
+
+    def _save_checkpoint_impl(self, save_dir, tag=None, client_state=None,
+                              save_latest=True):
         assert self._initialized, "cannot checkpoint before first batch"
         if tag is None:
             tag = f"global_step{self.global_steps}"
@@ -2052,8 +2161,19 @@ class DeepSpeedEngine:
         )
         import pickle
 
-        meta = pickle.loads(np.asarray(self.checkpoint_engine.load(
-            self._engine_states_path(load_dir, tag))["meta"]).tobytes())
+        engine_states = self._engine_states_path(load_dir, tag)
+        legacy_states = os.path.join(load_dir, str(tag), "engine_states.pkl")
+        if not os.path.exists(engine_states) and os.path.exists(legacy_states):
+            # checkpoints saved before the msgpack rename wrote the meta as
+            # a bare pickle file outside the checkpoint engine — load those
+            # directly so old save dirs stay restorable
+            log_dist(f"[ckpt] legacy engine_states.pkl found at {tag}; "
+                     "loading pre-msgpack meta", ranks=[0])
+            with open(legacy_states, "rb") as f:
+                meta = pickle.load(f)
+        else:
+            meta = pickle.loads(np.asarray(self.checkpoint_engine.load(
+                engine_states)["meta"]).tobytes())
         # a partial accumulation window from before the restore must not
         # leak into the first post-restore step
         self._host_grad_acc = None

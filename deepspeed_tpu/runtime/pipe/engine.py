@@ -23,6 +23,7 @@ TPU re-design (SURVEY.md §7 hard part (a)):
   allreduce).
 """
 
+import contextlib
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -48,6 +49,9 @@ from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
 from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules
 from deepspeed_tpu.utils.logging import log_dist
 from deepspeed_tpu.utils.timer import ThroughputTimer
+
+# shared no-op phase context when the step profiler is off (zero syncs)
+_NULL_PIPE_CTX = contextlib.nullcontext()
 
 
 class _StageModule(nn.Module):
@@ -183,6 +187,15 @@ class PipelineEngine:
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size,
             steps_per_output=config.steps_per_print)
+
+        # step-level performance tracer (docs/observability.md); pipeline
+        # phases: dataloader, h2d, schedule (the 1F1B clock stream) and
+        # optimizer. None when disabled — zero added syncs.
+        self.step_profiler = None
+        if config.step_profiler.enabled:
+            from deepspeed_tpu.profiling.step_profiler import StepProfiler
+
+            self.step_profiler = StepProfiler(config.step_profiler)
 
         log_dist(
             f"PipelineEngine: stages={self.num_stages}, "
@@ -393,15 +406,25 @@ class PipelineEngine:
         # stage fns trace lazily and model modules (VocabEmbed) read the
         # ambient topology at trace time — re-assert this engine's mesh
         set_default_topology(self.topology)
+        prof = self.step_profiler
+        if prof is not None:
+            prof.begin_step(self.global_steps)
+
+        def _phase(name):
+            return prof.phase(name) if prof is not None else _NULL_PIPE_CTX
+
         M, S = self.micro_batches, self.num_stages
         inputs, labels = [], []
         for _ in range(M):
-            batch = next(data_iter)
+            with _phase("dataloader"):
+                batch = next(data_iter)
             if self.curriculum_scheduler is not None:
                 batch = self._apply_curriculum(batch)
             x, lab = self._split_batch(batch)
-            inputs.append(self._put(x, 0))
-            labels.append(self._put(lab, S - 1) if lab is not None else None)
+            with _phase("h2d"):
+                inputs.append(self._put(x, 0))
+                labels.append(self._put(lab, S - 1)
+                              if lab is not None else None)
         if not self._initialized:
             self._init_state(inputs[0])
 
@@ -416,49 +439,54 @@ class PipelineEngine:
         losses = []
 
         sched = TrainSchedule(M, S)
-        for clock in sched.clocks():
-            for ins in clock:
-                s, m = ins.stage, ins.micro_batch
-                if ins.op == "load":
-                    acts[(0, m)] = inputs[m]
-                elif ins.op == "forward":
-                    x = acts[(s, m)]
-                    if s < S - 1:
-                        fargs = (self._params[s], x, rngs[s][m]) + (
-                            (theta,) if theta is not None else ())
-                        out = self._fwd_fn(s)(*fargs)
-                        acts[(s + 1, m)] = jax.device_put(
-                            out, self.stage_topos[s + 1].batch_sharding())
-                    # last stage fwd is fused into its backward (recompute)
-                elif ins.op == "backward":
-                    x = acts[(s, m)]
-                    textra = (theta,) if theta is not None else ()
-                    if s == S - 1:
-                        gp, gx, loss = self._bwd_fn(s)(
-                            self._params[s], x, labels[m], rngs[s][m],
-                            *textra)
-                        losses.append(loss)
-                    else:
-                        g = grads_in.pop(m)
-                        gp, gx = self._bwd_fn(s)(
-                            self._params[s], x, g, rngs[s][m], *textra)
-                    self._acc_grads[s] = jax.tree.map(
-                        jnp.add, self._acc_grads[s], gp)
-                    if s > 0:
-                        grads_in[m] = jax.device_put(
-                            gx, self.stage_topos[s - 1].batch_sharding())
-                        del acts[(s, m)]
-                    else:
-                        del acts[(s, m)]
+        with _phase("compiled_step"):
+            for clock in sched.clocks():
+                for ins in clock:
+                    s, m = ins.stage, ins.micro_batch
+                    if ins.op == "load":
+                        acts[(0, m)] = inputs[m]
+                    elif ins.op == "forward":
+                        x = acts[(s, m)]
+                        if s < S - 1:
+                            fargs = (self._params[s], x, rngs[s][m]) + (
+                                (theta,) if theta is not None else ())
+                            out = self._fwd_fn(s)(*fargs)
+                            acts[(s + 1, m)] = jax.device_put(
+                                out, self.stage_topos[s + 1].batch_sharding())
+                        # last stage fwd is fused into its backward
+                        # (recompute)
+                    elif ins.op == "backward":
+                        x = acts[(s, m)]
+                        textra = (theta,) if theta is not None else ()
+                        if s == S - 1:
+                            gp, gx, loss = self._bwd_fn(s)(
+                                self._params[s], x, labels[m], rngs[s][m],
+                                *textra)
+                            losses.append(loss)
+                        else:
+                            g = grads_in.pop(m)
+                            gp, gx = self._bwd_fn(s)(
+                                self._params[s], x, g, rngs[s][m], *textra)
+                        self._acc_grads[s] = jax.tree.map(
+                            jnp.add, self._acc_grads[s], gp)
+                        if s > 0:
+                            grads_in[m] = jax.device_put(
+                                gx, self.stage_topos[s - 1].batch_sharding())
+                            del acts[(s, m)]
+                        else:
+                            del acts[(s, m)]
 
-        self._sync_tied_grads()
-        self._optimizer_step()
+            self._sync_tied_grads()
+        with _phase("optimizer"):
+            self._optimizer_step()
         self.global_steps += 1
         self.micro_steps += M
         self.global_samples += self.train_batch_size
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self.tput_timer.stop(global_step=True)
+        if prof is not None:
+            prof.end_step(self.global_steps)
         mean_loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
         if self.global_steps % self._config.steps_per_print == 0:
             log_dist(f"pipe step={self.global_steps} loss={float(mean_loss):.4f}",

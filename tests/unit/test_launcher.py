@@ -148,3 +148,44 @@ def test_env_report_runs():
 
     rows = env_report.feature_table()
     assert any("jax backend" == r[0] for r in rows)
+
+
+class TestUserScriptIndex:
+    """Splitting the runner's own argv from the user script + args. A
+    first-occurrence ``raw.index(user_script)`` truncates runner options
+    whose VALUE happens to equal the script path; last-occurrence fails
+    when the script name recurs inside user_args. The arithmetic split
+    (REMAINDER pins the script at ``len(raw) - len(user_args) - 1``)
+    handles both."""
+
+    def split(self, raw):
+        from deepspeed_tpu.launcher.runner import _user_script_index
+
+        args = parse_args(raw)
+        return _user_script_index(raw, args.user_script, args.user_args)
+
+    def test_option_value_decoys_script_path(self):
+        # --include's VALUE equals the script path; first-occurrence index
+        # would split at position 1 and truncate --master_port
+        raw = ["--include", "train.py", "--master_port", "29501",
+               "train.py", "--epochs", "1"]
+        assert self.split(raw) == 4
+
+    def test_script_name_recurs_in_user_args(self):
+        # the mirror case: last-occurrence rindex would split at the copy
+        # inside user_args
+        raw = ["--master_port", "29501", "train.py",
+               "--teacher-script", "train.py"]
+        assert self.split(raw) == 2
+
+    def test_plain_invocation(self):
+        raw = ["train.py", "--epochs", "3"]
+        assert self.split(raw) == 0
+
+    def test_rindex_fallback_for_foreign_argv(self):
+        # argv not produced by parse_args verbatim (arithmetic misses):
+        # fall back to the last occurrence of the script token
+        from deepspeed_tpu.launcher.runner import _user_script_index
+
+        raw = ["--something", "train.py", "extra"]
+        assert _user_script_index(raw, "train.py", ["a", "b", "c"]) == 1
