@@ -2,8 +2,9 @@
 # .github/workflows/: formatting + unit suites + op pre-compile; here the
 # TPU-facing perf gate is the extra axis).
 #
-#   make quick   fast confidence: imports + the fast unit subset (~2 min,
-#                virtual CPU mesh) — what the pre-push hook runs
+#   make quick   fast confidence: imports + the fast unit subset
+#                (<5 min, virtual CPU mesh, `-m "not slow"`) — what the
+#                pre-push hook runs
 #   make test    full unit suite on the 8-device virtual CPU mesh
 #   make smoke   perf regression gate on the real chip
 #                (benchmarks/smoke.py vs committed expected.json, +-10%)
@@ -28,10 +29,17 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
 
 .PHONY: quick test smoke chaos profile check hooks hot-changed
 
+# the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
+# process-group units, with tests marked `slow` (pyproject marker) opted
+# out — mark compile-heavy tests slow rather than dropping whole files
 quick:
 	$(PY) -c "import deepspeed_tpu; import __graft_entry__; print('imports ok')"
 	$(PY) -m pytest tests/unit/test_config.py tests/unit/test_mesh.py \
-	  tests/unit/test_ops.py -q -x
+	  tests/unit/test_ops.py tests/unit/test_comm.py \
+	  tests/unit/test_compressed_comm.py tests/unit/test_bucketed_comm.py \
+	  tests/unit/test_grad_exchange_modes.py \
+	  tests/unit/test_flash_autotune.py tests/unit/test_procgroup.py \
+	  tests/unit/test_launcher.py -q -x -m "not slow"
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -23,8 +23,14 @@ Accounting conventions (also in docs/observability.md):
   per_exchange. This is the deployment-relevant ratio: at gas>=2 the
   int8 path is < 0.5x bf16 on the wire.
 
-Exchanges are per-leaf (the engine groups leaves before quantizing;
-grouping only changes block-padding waste, not the headline ratio).
+``fp32``/``bf16``/``int8`` exchange per-leaf; the ``*_bucketed`` modes
+exchange through ``comm/bucketed.py`` plans (``tpu.grad_exchange``) —
+deterministic size-bounded leaf buckets whose collectives form independent
+dataflow chains XLA's latency-hiding scheduler can overlap, reported here
+with bucket count and per-bucket payload/sideband wire bytes. Grouping
+only changes block-padding waste, not the headline compression ratio —
+the bucketed rows exist to pin down the per-bucket wire sizes the overlap
+analysis in docs/performance.md reasons about.
 
   python benchmarks/communication/grad_exchange.py            # 1.3B
   python benchmarks/communication/grad_exchange.py --tiny     # CI-sized
@@ -56,6 +62,11 @@ from jax.experimental.shard_map import shard_map  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 from deepspeed_tpu.comm import comm as dist  # noqa: E402
+from deepspeed_tpu.comm.bucketed import (  # noqa: E402
+    bucketed_all_reduce,
+    bucketed_quantized_all_reduce,
+    plan_for_tree,
+)
 from deepspeed_tpu.comm.compressed import quantized_all_reduce  # noqa: E402
 from deepspeed_tpu.comm.logging import comms_logger  # noqa: E402
 
@@ -94,13 +105,29 @@ def grad_shapes_tiny():
     }
 
 
-def measure_exchange(grads, fmt: str, mesh, block: int = 512) -> dict:
+def measure_exchange(grads, fmt: str, mesh, block: int = 512,
+                     bucket_mb: float = 4.0) -> dict:
     """Trace one whole-pytree gradient exchange in ``fmt`` and return the
-    logger's wire accounting (bytes per device, ring-accounted)."""
+    logger's wire accounting (bytes per device, ring-accounted).
+
+    Bucketed modes (``bf16_bucketed`` / ``int8_bucketed``) exchange
+    size-bounded leaf buckets — mutually independent collective chains
+    XLA's latency-hiding scheduler can overlap — and report each bucket's
+    wire bytes from its own ``.bucket<i>`` log record."""
+    plan = (plan_for_tree(grads, bucket_mb)
+            if fmt.endswith("_bucketed") else None)
+
     def exchange(g):
         if fmt == "int8":
             return jax.tree.map(
                 lambda x: quantized_all_reduce(x, AXIS, block=block), g)
+        if fmt == "int8_bucketed":
+            out, _, _ = bucketed_quantized_all_reduce(
+                g, AXIS, plan, block=block)
+            return out
+        if fmt == "bf16_bucketed":
+            return bucketed_all_reduce(g, AXIS, plan,
+                                       wire_dtype=jnp.bfloat16)
         wire = jnp.float32 if fmt == "fp32" else jnp.bfloat16
         return jax.tree.map(
             lambda x: dist.all_reduce(x.astype(wire), AXIS), g)
@@ -123,6 +150,20 @@ def measure_exchange(grads, fmt: str, mesh, block: int = 512) -> dict:
             "quantized_all_reduce_wire_bytes", 0.0)
         out["sideband_wire_bytes"] = counters.get(
             "quantized_all_reduce.scales_wire_bytes", 0.0)
+    if plan is not None:
+        base = ("quantized_all_reduce" if fmt == "int8_bucketed"
+                else "bucketed_all_reduce")
+        buckets = []
+        for b, n in enumerate(plan.bucket_sizes()):
+            rec = {"elements": int(n),
+                   "payload_wire_bytes": int(counters.get(
+                       f"{base}.bucket{b}_wire_bytes", 0.0))}
+            if fmt == "int8_bucketed":
+                rec["sideband_wire_bytes"] = int(counters.get(
+                    f"{base}.bucket{b}.scales_wire_bytes", 0.0))
+            buckets.append(rec)
+        out["bucket_count"] = plan.num_buckets
+        out["buckets"] = buckets
     return out
 
 
@@ -136,6 +177,9 @@ def main(argv=None) -> int:
                         "accounting (>=2 is the deployment config)")
     p.add_argument("--block", type=int, default=512,
                    help="int8 quantization block (engine default)")
+    p.add_argument("--bucket-mb", type=float, default=4.0,
+                   help="bucket byte budget for the *_bucketed modes "
+                        "(tpu.grad_exchange.bucket_mb)")
     p.add_argument("--out", default=None,
                    help="results JSON path (default: "
                         "grad_exchange_results.json beside this script)")
@@ -149,11 +193,15 @@ def main(argv=None) -> int:
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(grads))
 
     formats = {}
-    for fmt in ("fp32", "bf16", "int8"):
-        per_ex = measure_exchange(grads, fmt, mesh, block=args.block)
-        exchanges = 1 if fmt == "int8" else args.gas
+    for fmt in ("fp32", "bf16", "int8", "bf16_bucketed", "int8_bucketed"):
+        per_ex = measure_exchange(grads, fmt, mesh, block=args.block,
+                                  bucket_mb=args.bucket_mb)
+        # plain paths all-reduce every micro step; int8 and the bucketed
+        # (deferred-boundary) modes ship worker grads ONCE per step
+        exchanges = args.gas if fmt in ("fp32", "bf16") else 1
         formats[fmt] = {
-            **{k: int(v) for k, v in per_ex.items()},
+            **{k: (int(v) if isinstance(v, float) else v)
+               for k, v in per_ex.items()},
             "exchanges_per_step": exchanges,
             "per_step_wire_bytes": int(per_ex["wire_bytes"] * exchanges),
         }
@@ -167,10 +215,20 @@ def main(argv=None) -> int:
         "world": world,
         "gas": args.gas,
         "block": args.block,
+        "bucket_mb": args.bucket_mb,
         "accounting": "ring wire bytes per device, traced via eval_shape "
-                      "(comm/logging.py wire_factor); per-leaf exchanges",
+                      "(comm/logging.py wire_factor); per-leaf exchanges "
+                      "for fp32/bf16/int8, size-bounded buckets "
+                      "(comm/bucketed.py, independent collective chains "
+                      "XLA can overlap) for *_bucketed",
         "formats": formats,
         "ratios": {
+            "per_step_int8_bucketed_vs_bf16": round(
+                formats["int8_bucketed"]["per_step_wire_bytes"]
+                / formats["bf16"]["per_step_wire_bytes"], 4),
+            "per_step_bf16_bucketed_vs_bf16": round(
+                formats["bf16_bucketed"]["per_step_wire_bytes"]
+                / formats["bf16"]["per_step_wire_bytes"], 4),
             "per_exchange_int8_vs_bf16": round(
                 formats["int8"]["wire_bytes"] / bf16_ex, 4),
             "per_exchange_int8_vs_fp32": round(

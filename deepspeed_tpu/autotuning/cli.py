@@ -19,6 +19,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.procgroup import (reap_process_group,
+                                           spawn_process_group)
 
 RESULT_ENV = "DS_TPU_AUTOTUNING_RESULT"
 END_STEP_ENV = "DS_TPU_AUTOTUNING_END_STEP"
@@ -135,9 +137,17 @@ def run_autotuning(mode: str, user_script: str, user_args: List[str],
         log_path = os.path.join(exp_dir, "stdout.log")
         try:
             with open(log_path, "wb") as log_f:
-                proc = subprocess.run(
-                    cmd, env=env, timeout=timeout_s,
-                    stdout=log_f, stderr=subprocess.STDOUT)
+                # own process group: on timeout the WHOLE experiment tree
+                # is reaped (TERM -> KILL), not just the direct child —
+                # a leaked JAX worker would hold the local chips busy for
+                # every subsequent experiment
+                proc = spawn_process_group(
+                    cmd, env=env, stdout=log_f, stderr=subprocess.STDOUT)
+                try:
+                    proc.wait(timeout=timeout_s)
+                except subprocess.TimeoutExpired:
+                    reap_process_group(proc)
+                    raise
             ok = proc.returncode == 0 and os.path.exists(metric_path)
         except subprocess.TimeoutExpired:
             ok = False
@@ -199,7 +209,14 @@ def run_autotuning(mode: str, user_script: str, user_args: List[str],
         env.pop(RESULT_ENV, None)
         cmd = [sys.executable, user_script] + _swapped_args(
             user_args, cfg_idx, best_cfg)
-        return subprocess.call(cmd, env=env)
+        # production relaunch in its own group: a ctrl-C here must not
+        # leave the (freshly tuned, long-running) training tree behind
+        proc = spawn_process_group(cmd, env=env)
+        try:
+            return proc.wait()
+        except KeyboardInterrupt:
+            reap_process_group(proc)
+            raise
     return code
 
 

@@ -47,7 +47,8 @@ def server_shard_length(n: int, w: int, block: int = 512) -> int:
 
 def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
                          return_error: bool = False,
-                         server_error: jnp.ndarray = None
+                         server_error: jnp.ndarray = None,
+                         log_name: str = "quantized_all_reduce"
                          ) -> Union[jnp.ndarray,
                                     Tuple[jnp.ndarray, jnp.ndarray],
                                     Tuple[jnp.ndarray, jnp.ndarray,
@@ -70,6 +71,11 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     requantization and the new residual is returned as a third output
     ``(out, worker_err, new_server_error)``. Without it, phase-2
     requantization noise (~1/127 relative per step) goes uncompensated.
+
+    ``log_name`` labels the wire accounting (payload under ``log_name``,
+    scale sideband under ``<log_name>.scales``) so callers issuing many
+    exchanges — e.g. the bucketed reducer in ``comm/bucketed.py`` — can
+    meter each one separately.
     """
     w = int(lax.psum(1, axis))  # static axis size at trace time
     shape, dtype = x.shape, x.dtype
@@ -88,9 +94,9 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     # tensor never does) — log both under distinct names so the comm
     # benchmarks can report payload vs sideband
     comms_logger.append("all_to_all", q, axis,
-                        log_name="quantized_all_reduce", world=w)
+                        log_name=log_name, world=w)
     comms_logger.append("all_to_all", s, axis,
-                        log_name="quantized_all_reduce.scales", world=w)
+                        log_name=f"{log_name}.scales", world=w)
     q_recv = lax.all_to_all(q.reshape(w, per), axis,
                             split_axis=0, concat_axis=0, tiled=False)
     s_recv = lax.all_to_all(s.reshape(w, per // block), axis,
@@ -105,9 +111,9 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     # phase 2: re-quantize the reduced shard, all_gather, dequantize
     q2, s2 = _quantize_blocks(reduced, block)
     comms_logger.append("all_gather", q2, axis,
-                        log_name="quantized_all_reduce", world=w)
+                        log_name=log_name, world=w)
     comms_logger.append("all_gather", s2, axis,
-                        log_name="quantized_all_reduce.scales", world=w)
+                        log_name=f"{log_name}.scales", world=w)
     q_all = lax.all_gather(q2, axis, tiled=True)      # [W * per]
     s_all = lax.all_gather(s2, axis, tiled=True)      # [W * per/block]
     out = dequantize(q_all, s_all)

@@ -26,6 +26,8 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.procgroup import (reap_process_group,
+                                           spawn_process_group)
 
 DEFAULT_MASTER_PORT = 29500
 
@@ -301,7 +303,10 @@ def main(argv=None) -> int:
         if args.dry_run:
             print(" ".join(shlex.quote(c) for c in cmd))
             continue
-        procs.append(subprocess.Popen(cmd))
+        # own process group per worker: interrupting the launcher must reap
+        # the worker's whole tree (a JAX child masking/outliving TERM was
+        # the 21-hour leak of ROADMAP item 1), not just the direct child
+        procs.append(spawn_process_group(cmd))
     if args.dry_run:
         return 0
 
@@ -312,7 +317,7 @@ def main(argv=None) -> int:
             rc = rc or p.returncode
     except KeyboardInterrupt:
         for p in procs:
-            p.terminate()
+            reap_process_group(p)
         rc = 1
     return rc
 

@@ -71,6 +71,11 @@ class GPTConfig:
     # v5e chip — seq 128: 56 vs 45 TFLOPS for XLA; 512: 49 vs 45 flash;
     # 2048: 47 vs 25; 4096: 48 vs 12)
     use_flash_attention: Any = False
+    # opt into LIVE flash block autotuning (ops/pallas/autotune.py): first
+    # compile at a new (seq, head_dim, dtype, device) benchmarks the
+    # candidate grid and persists the winner to the on-disk cache. Off =
+    # cached/pretuned blocks still apply; only the benchmarking is gated.
+    flash_autotune: bool = False
     # chunked online-softmax attention (ops/chunked_attention.py): bounded
     # O(T * chunk) score memory in plain XLA — the long-context path where
     # the flash kernel's VMEM ceiling binds (seq > 8192 on the current
@@ -596,7 +601,9 @@ class CausalSelfAttention(nn.Module):
         if use_flash:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            y = flash_attention(q, k, v, causal=cfg.causal)
+            y = flash_attention(q, k, v, causal=cfg.causal,
+                                autotune=True if cfg.flash_autotune
+                                else None)
         else:
             scale = 1.0 / np.sqrt(D)
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

@@ -1,0 +1,236 @@
+"""Shape-tuned (block_q, block_k) selection for the flash-attention kernel.
+
+``largest_divisor_block``'s fixed ``want`` heuristic picks the largest
+divisor of the sequence length — shape-blind: for CAUSAL attention the
+kernel skips fully-masked K blocks (``nk_eff`` pruning in
+``flash_attention.py``), so a smaller ``block_k`` does strictly less work
+per q-row, while a larger ``block_q`` amortizes grid overhead. The best
+trade depends on (seq, head_dim, dtype, device) — exactly what a fixed
+default cannot know.
+
+Resolution order for :func:`get_flash_blocks` (first hit wins):
+
+1. in-memory cache (one lookup per process per key)
+2. on-disk JSON cache — ``$DS_TPU_PALLAS_CACHE`` or
+   ``~/.cache/deepspeed_tpu/flash_blocks.json``, keyed by
+   ``device_kind|seq|head_dim|dtype|causal``; written by a previous
+   autotune run on this host. A corrupt/unreadable file falls through
+   (warn once) and is overwritten by the next tuned write.
+3. shipped pretuned table (:data:`PRETUNED`) — seeds for the shapes the
+   1.3B benchmark config hits, derived from the kernel's VMEM/pruning
+   model (docs/performance.md); refreshed in place by live autotunes.
+4. live benchmark at the actual shape, IF enabled (``autotune=True`` or
+   ``DS_TPU_FLASH_AUTOTUNE=1``): times the jitted fwd+bwd over a
+   divisor-filtered candidate grid and persists the winner to (2).
+5. the ``largest_divisor_block`` heuristic — today's default, unchanged.
+
+Every cached/pretuned entry is re-validated against the current shape
+(divisibility) before use, so a stale or hand-edited cache can never
+produce an invalid launch. Default-safe: with no cache, no pretuned hit,
+and autotuning off, behavior is identical to the old fixed default.
+"""
+
+import json
+import os
+import threading
+import warnings
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.pallas.common import largest_divisor_block
+
+_CACHE_ENV = "DS_TPU_PALLAS_CACHE"
+_AUTOTUNE_ENV = "DS_TPU_FLASH_AUTOTUNE"
+_DEFAULT_WANT = 512  # flash_attention's historical fixed block default
+
+# (device_kind, seq, head_dim, dtype, causal) -> (block_q, block_k).
+# Seeds for the 1.3B/seq-1024 shape (n_embd=2048 / 16 heads -> d=128):
+# causal entries keep block_k at seq/4 so the kernel's nk_eff pruning
+# skips ~ the upper-triangle (block_k=seq would always compute the full
+# square), and block_q at seq/2 to halve grid launches. A live autotune
+# (DS_TPU_FLASH_AUTOTUNE=1) overwrites these via the disk cache.
+PRETUNED: Dict[Tuple[str, int, int, str, bool], Tuple[int, int]] = {}
+for _kind in ("TPU v4", "TPU v5 lite", "TPU v5e", "TPU v5p", "TPU v6 lite",
+              "TPU v6e"):
+    for _dt in ("bfloat16", "float32"):
+        PRETUNED[(_kind, 1024, 128, _dt, True)] = (512, 256)
+        PRETUNED[(_kind, 2048, 128, _dt, True)] = (512, 256)
+
+_lock = threading.Lock()
+_mem_cache: Dict[str, Tuple[int, int]] = {}
+_disk_warned = False
+
+
+def cache_path() -> str:
+    return os.environ.get(_CACHE_ENV) or os.path.join(
+        os.path.expanduser("~"), ".cache", "deepspeed_tpu",
+        "flash_blocks.json")
+
+
+def cache_key(device_kind: str, t: int, d: int, dtype, causal: bool) -> str:
+    return f"{device_kind}|{int(t)}|{int(d)}|{jnp.dtype(dtype).name}|" \
+           f"{bool(causal)}"
+
+
+def _load_disk_cache() -> Dict[str, List[int]]:
+    global _disk_warned
+    path = cache_path()
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError(f"expected a JSON object, got {type(data)}")
+        return data
+    except (OSError, ValueError) as e:
+        if not _disk_warned:
+            _disk_warned = True
+            warnings.warn(
+                f"ignoring corrupt Pallas autotune cache {path!r} ({e}); "
+                "falling back to the block-size heuristic — the next "
+                "autotune run rewrites it", RuntimeWarning)
+        return {}
+
+
+def _store_disk_cache(key: str, blocks: Tuple[int, int]) -> None:
+    path = cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    data = _load_disk_cache()
+    data[key] = [int(blocks[0]), int(blocks[1])]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _valid(blocks, t: int) -> Optional[Tuple[int, int]]:
+    """Sanity-check a cached/pretuned entry against the current shape."""
+    try:
+        bq, bk = int(blocks[0]), int(blocks[1])
+    except (TypeError, ValueError, IndexError):
+        return None
+    if bq < 1 or bk < 1 or t % bq or t % bk:
+        return None
+    return bq, bk
+
+
+def default_candidates(t: int) -> List[Tuple[int, int]]:
+    """Divisor-filtered (block_q, block_k) grid around the MXU-friendly
+    power-of-two sizes, bounded so the f32 score tile stays well under a
+    VMEM core (block_q*block_k <= 512*1024 -> 2 MB)."""
+    sizes = [b for b in (128, 256, 512, 1024) if b <= t and t % b == 0]
+    if not sizes:  # short/odd seq: fall back to the divisor heuristic sizes
+        sizes = sorted({largest_divisor_block(t, w)
+                        for w in (128, 256, 512)})
+    return [(bq, bk) for bq in sizes for bk in sizes
+            if bq * bk <= 512 * 1024]
+
+
+def benchmark_candidates(t: int, d: int, dtype, causal: bool,
+                         candidates: List[Tuple[int, int]],
+                         batch_heads: int = 4, iters: int = 3
+                         ) -> Tuple[int, int]:
+    """Time the jitted flash fwd+bwd at the actual (seq, head_dim) shape
+    for each candidate and return the fastest. One compile + ``iters``
+    timed runs per candidate; called once per (shape, device) ever, the
+    winner is persisted to the disk cache."""
+    import time
+
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    shape = (1, t, batch_heads, d)
+    q = jnp.asarray(rng.randn(*shape), jnp.dtype(dtype))
+    k = jnp.asarray(rng.randn(*shape), jnp.dtype(dtype))
+    v = jnp.asarray(rng.randn(*shape), jnp.dtype(dtype))
+
+    best, best_dt = None, float("inf")
+    for bq, bk in candidates:
+
+        def loss(q, k, v, bq=bq, bk=bk):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk))
+
+        try:
+            step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            jax.block_until_ready(step(q, k, v))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(step(q, k, v))
+            dt = (time.perf_counter() - t0) / iters
+        except Exception as e:  # candidate failed to compile/run: skip it
+            warnings.warn(
+                f"flash autotune candidate ({bq},{bk}) failed: {e}",
+                RuntimeWarning)
+            continue
+        if dt < best_dt:
+            best, best_dt = (bq, bk), dt
+    if best is None:
+        raise RuntimeError(
+            f"flash autotune: no candidate ran for t={t} d={d}")
+    return best
+
+
+def get_flash_blocks(t: int, d: int, dtype, causal: bool, *,
+                     want_q: int = _DEFAULT_WANT,
+                     want_k: int = _DEFAULT_WANT,
+                     autotune: Optional[bool] = None,
+                     candidates: Optional[List[Tuple[int, int]]] = None
+                     ) -> Tuple[int, int]:
+    """Resolve (block_q, block_k) for a flash-attention launch.
+
+    ``autotune=None`` defers to the ``DS_TPU_FLASH_AUTOTUNE`` env flag;
+    ``candidates`` overrides the benchmark grid (tests use tiny ones).
+    """
+    heuristic = (largest_divisor_block(t, want_q),
+                 largest_divisor_block(t, want_k))
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        return heuristic
+    key = cache_key(device_kind, t, d, dtype, causal)
+
+    with _lock:
+        hit = _mem_cache.get(key)
+        if hit is not None:
+            return hit
+        entry = _valid(_load_disk_cache().get(key), t)
+        if entry is not None:
+            _mem_cache[key] = entry
+            return entry
+        pre = _valid(PRETUNED.get(
+            (device_kind, int(t), int(d), jnp.dtype(dtype).name,
+             bool(causal))), t)
+        if pre is not None:
+            _mem_cache[key] = pre
+            return pre
+
+    if autotune is None:
+        autotune = os.environ.get(_AUTOTUNE_ENV, "0") not in ("", "0")
+    if not autotune:
+        return heuristic
+
+    tuned = benchmark_candidates(
+        t, d, dtype, causal, candidates or default_candidates(t))
+    with _lock:
+        _mem_cache[key] = tuned
+        try:
+            _store_disk_cache(key, tuned)
+        except OSError as e:
+            warnings.warn(
+                f"flash autotune: could not persist winner to "
+                f"{cache_path()!r} ({e}); it stays in-memory for this "
+                "process", RuntimeWarning)
+    return tuned
+
+
+def clear_memory_cache() -> None:
+    """Test hook: drop the per-process memoization (disk cache untouched)."""
+    global _disk_warned
+    with _lock:
+        _mem_cache.clear()
+        _disk_warned = False

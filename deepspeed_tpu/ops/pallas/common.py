@@ -14,7 +14,13 @@ def interpret() -> bool:
 
 
 def largest_divisor_block(t: int, want: int = 128) -> int:
-    """Largest block size <= want dividing t."""
+    """Largest block size <= want dividing t.
+
+    Shape-blind FALLBACK: kernels that care about the (seq, head_dim,
+    device) trade-off — flash attention's causal block pruning above all —
+    resolve blocks through ``ops/pallas/autotune.get_flash_blocks``
+    (pretuned table / disk cache / live benchmark) and only land here when
+    nothing better is known for the shape."""
     b = min(want, t)
     while t % b:
         b -= 1

@@ -292,15 +292,28 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
-                    block_q: int = 512, block_k: int = 512):
+                    block_q: int = None, block_k: int = None,
+                    autotune: bool = None):
     """Blockwise attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     Memory is O(seq) per program instead of O(seq^2); the [T, T] score matrix
     only ever exists one [block_q, block_k] tile at a time in VMEM.
+
+    ``block_q``/``block_k`` default to the shape-tuned resolution in
+    ``ops/pallas/autotune.py`` (disk cache -> pretuned table -> optional
+    live benchmark gated by ``autotune``/``DS_TPU_FLASH_AUTOTUNE`` -> the
+    historical want-512 divisor heuristic); pass them explicitly to pin.
     """
     b, t, h, d = q.shape
     if scale is None:
         scale = 1.0 / np.sqrt(d)
+    if block_q is None or block_k is None:
+        from deepspeed_tpu.ops.pallas.autotune import get_flash_blocks
+
+        tuned_q, tuned_k = get_flash_blocks(
+            t, d, q.dtype, causal, autotune=autotune)
+        block_q = tuned_q if block_q is None else block_q
+        block_k = tuned_k if block_k is None else block_k
     block_q = _block(t, block_q)
     block_k = _block(t, block_k)
     of = _flash(q, k, v, float(scale), bool(causal), block_q, block_k)

@@ -194,10 +194,41 @@ def ring_engaged(model_cfg):
         return None
     if getattr(model_cfg, "sparse_kv_cache", False) not in ("auto", True):
         return None
+    demanded = getattr(model_cfg, "sparse_kv_cache", False) is True
     ring = ring_decode_params(sc)
     if ring is None:
+        if demanded:
+            _decline_demanded_ring(
+                f"layout {type(sc).__name__} has no ring expression")
         return None
     w_blk, g_tok, blk = ring
     if g_tok + (w_blk + 1) * blk >= model_cfg.n_positions:
-        return None  # ring would not be smaller than the dense cache
+        # ring would not be smaller than the dense cache
+        if demanded:
+            _decline_demanded_ring(
+                f"ring span {g_tok + (w_blk + 1) * blk} (global {g_tok} + "
+                f"window ({w_blk}+1) x block {blk}) >= n_positions "
+                f"{model_cfg.n_positions} — the compact cache would not be "
+                "smaller than dense")
+        return None
     return ring
+
+
+# Newest-last reasons every time an EXPLICIT sparse_kv_cache=True was
+# declined (test/debug hook for the warn-and-record below; "auto" declines
+# stay silent — auto means "ring only when it helps").
+RING_DECLINES: list = []
+
+
+def _decline_demanded_ring(reason: str) -> None:
+    """sparse_kv_cache=True is a demand, not a hint: record + warn instead
+    of silently decoding dense, so the config cannot lie about what the
+    cache is doing (dense decode consults MORE keys than ring-sparse
+    training did — docs/DIVERGENCES.md, Inference section)."""
+    import warnings
+
+    RING_DECLINES.append(reason)
+    warnings.warn(
+        "sparse_kv_cache=True but the ring KV cache is NOT engaged; decode "
+        f"falls back to DENSE attention: {reason}", RuntimeWarning,
+        stacklevel=3)

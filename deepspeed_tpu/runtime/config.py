@@ -386,6 +386,36 @@ class MeshConfig(ConfigModel):
 
 
 @dataclass
+class GradExchangeConfig(ConfigModel):
+    """Explicit bucketed gradient exchange (``comm/bucketed.py``).
+
+    ``deferred=True`` replaces XLA's implicit per-micro-step gradient psum
+    with the compressed-path machinery at a bf16/fp32 wire format: grads
+    stay per-worker through the accumulation window and are exchanged ONCE
+    per optimizer step in size-bounded buckets at the GAS boundary (T3-style
+    — cuts gradient wire bytes by the accumulation factor and frees XLA to
+    overlap per-bucket collectives). ``bucket_mb`` also buckets the int8
+    ``communication_data_type`` exchange (error-feedback residuals become
+    per-bucket). 0 keeps the legacy per-leaf exchange. Defaults are
+    off/safe: nothing changes unless explicitly enabled.
+    """
+
+    bucket_mb: float = 0.0
+    deferred: bool = False
+    wire_dtype: str = "bf16"  # bf16 | fp32 (deferred exchange payload)
+
+    def __post_init__(self):
+        if self.wire_dtype not in ("bf16", "bfloat16", "fp32", "float32"):
+            raise DeepSpeedConfigError(
+                "tpu.grad_exchange.wire_dtype must be one of bf16/bfloat16/"
+                f"fp32/float32, got {self.wire_dtype!r}")
+        if self.bucket_mb < 0:
+            raise DeepSpeedConfigError(
+                f"tpu.grad_exchange.bucket_mb must be >= 0, got "
+                f"{self.bucket_mb}")
+
+
+@dataclass
 class TpuConfig(ConfigModel):
     mesh: Dict[str, Any] = field(default_factory=dict)
     remat: str = "none"  # none | full | selective (dots_saveable)
@@ -400,10 +430,16 @@ class TpuConfig(ConfigModel):
     # get_global_grad_norm() and monitors keep working. The int8 path
     # materializes its post-exchange norm for free and ignores this flag.
     compressed_grad_norm: bool = False
+    # explicit bucketed gradient exchange — see GradExchangeConfig
+    grad_exchange: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def mesh_config(self) -> MeshConfig:
         return MeshConfig.from_dict(self.mesh)
+
+    @property
+    def grad_exchange_config(self) -> GradExchangeConfig:
+        return GradExchangeConfig.from_dict(self.grad_exchange)
 
 
 # ---------------------------------------------------------------------------

@@ -286,16 +286,26 @@ class DeepSpeedEngine:
         # data-parallel axis, so the step keeps PER-WORKER gradients.
         self._compressed_mode = None
         self._comp_k = None
+        self._bucket_plan = None  # comm/bucketed.py plan, set at state init
+        self._gx_wire_dtype = jnp.bfloat16
         if optimizer is None and is_compressed_optimizer(config.optimizer.type):
             self._compressed_mode = "onebit"
         elif config.communication_data_type == "int8":
             self._compressed_mode = "int8"
+        elif (config.tpu.grad_exchange_config.deferred
+              and topology.size("dp") > 1):
+            # deferred bucketed exchange (comm/bucketed.py): the compressed
+            # machinery at a bf16/fp32 wire — per-worker grads through the
+            # accumulation window, ONE bucketed explicit exchange at the GAS
+            # boundary instead of XLA's implicit psum every micro step
+            self._compressed_mode = "deferred"
         if self._compressed_mode is not None:
             self._validate_compressed_config(config, topology)
         # whether the compressed step materializes a real averaged-grad norm
-        # (int8: free from the post-exchange mean; onebit: debug-gated)
+        # (int8/deferred: free from the post-exchange mean; onebit:
+        # debug-gated)
         self._compressed_norm_available = (
-            self._compressed_mode == "int8"
+            self._compressed_mode in ("int8", "deferred")
             or (self._compressed_mode == "onebit"
                 and config.tpu.compressed_grad_norm))
         # ZeRO shards over the fsdp axis: when the user asked for a ZeRO stage
@@ -799,6 +809,22 @@ class DeepSpeedEngine:
         self._param_specs = jax.tree.map(lambda _: P(), param_shapes)
         self._grad_specs = jax.tree.map(lambda _: P(axis), param_shapes)
 
+        # bucket plan for the explicit exchange (comm/bucketed.py):
+        # deferred always buckets (bucket_mb=0 -> one leaf per bucket);
+        # int8 buckets only when asked — its error-feedback buffers change
+        # shape with the plan, and the legacy per-leaf layout must stay the
+        # default for existing checkpoints
+        gx = self._config.tpu.grad_exchange_config
+        self._bucket_plan = None
+        if (self._compressed_mode == "deferred"
+                or (self._compressed_mode == "int8" and gx.bucket_mb > 0)):
+            from deepspeed_tpu.comm.bucketed import plan_for_tree
+
+            self._bucket_plan = plan_for_tree(param_shapes, gx.bucket_mb)
+        self._gx_wire_dtype = (jnp.float32
+                               if gx.wire_dtype in ("fp32", "float32")
+                               else jnp.bfloat16)
+
         if self._compressed_mode == "onebit":
             st_shape = jax.eval_shape(self._tx.init, param_shapes)
             cls = type(st_shape)
@@ -823,6 +849,34 @@ class DeepSpeedEngine:
             self._opt_state = jax.jit(jax.shard_map(
                 init_global, mesh=mesh, in_specs=(self._param_specs,),
                 out_specs=self._opt_specs, check_vma=False))(self._params)
+        elif self._compressed_mode == "deferred":
+            # bf16/fp32 wire: no quantization, no error feedback — state is
+            # just the inner optimizer (1-tuple keeps the (inner, ...) shape
+            # of the explicit-exchange family for checkpoints)
+            inner = jax.jit(self._tx.init)(self._params)
+            self._opt_state = (inner,)
+            self._opt_specs = (jax.tree.map(lambda _: P(), inner),)
+        elif self._bucket_plan is not None:
+            # bucketed int8: residuals live on the flat concatenated bucket
+            # payloads, one worker + one server buffer per BUCKET (the
+            # compensation spans exactly what each exchange quantizes)
+            from deepspeed_tpu.comm.compressed import server_shard_length
+
+            inner = jax.jit(self._tx.init)(self._params)
+            k = self._comp_k
+            sizes = self._bucket_plan.bucket_sizes()
+            err = tuple(
+                jax.jit(lambda n=n: jnp.zeros((k, n), jnp.float32),
+                        out_shardings=pw)() for n in sizes)
+            serr = tuple(
+                jax.jit(lambda m=server_shard_length(n, k): jnp.zeros(
+                    (k, m), jnp.float32), out_shardings=pw)()
+                for n in sizes)
+            self._opt_state = (inner, err, serr)
+            self._opt_specs = (
+                jax.tree.map(lambda _: P(), inner),
+                tuple(P(axis) for _ in err),
+                tuple(P(axis) for _ in serr))
         else:  # int8 quantized grad allreduce, any optax optimizer
             from deepspeed_tpu.comm.compressed import server_shard_length
 
@@ -861,6 +915,8 @@ class DeepSpeedEngine:
         mesh = self.topology.mesh
         k = self._comp_k
         mode = self._compressed_mode
+        plan = self._bucket_plan
+        wire = self._gx_wire_dtype
 
         clip = self.gradient_clipping
         debug_norm = self._config.tpu.compressed_grad_norm
@@ -891,6 +947,32 @@ class DeepSpeedEngine:
                         lambda x: x[None], new_st.worker_error),
                     server_error=jax.tree.map(
                         lambda x: x[None], new_st.server_error))
+            elif mode == "deferred":
+                from deepspeed_tpu.comm.bucketed import bucketed_all_reduce
+
+                (inner,) = opt_state
+                # ONE bucketed explicit exchange at the GAS boundary: each
+                # bucket is an independent collective XLA may overlap with
+                # the others' cast/unpack compute (T3-style)
+                mean_g = bucketed_all_reduce(
+                    local_g, "dp", plan, wire_dtype=wire, mean=True,
+                    log_name="bucketed_grad_exchange")
+                new_opt_tail = ()
+            elif plan is not None:
+                from deepspeed_tpu.comm.bucketed import (
+                    bucketed_quantized_all_reduce)
+
+                inner, err, serr = opt_state
+                # per-BUCKET int8 exchange: independent collective chains
+                # (vs the serial per-leaf loop) with residuals carried on
+                # the flat bucket payloads
+                summed, e2s, se2s = bucketed_quantized_all_reduce(
+                    local_g, "dp", plan,
+                    worker_errors=[e[0] for e in err],
+                    server_errors=[se[0] for se in serr])
+                mean_g = jax.tree.map(lambda r: r / k, summed)
+                new_opt_tail = (tuple(e[None] for e in e2s),
+                                tuple(se[None] for se in se2s))
             else:
                 from deepspeed_tpu.comm.compressed import quantized_all_reduce
 
@@ -906,6 +988,9 @@ class DeepSpeedEngine:
                     new_err.append(e2[None])
                     new_serr.append(se2[None])
                 mean_g = jax.tree.unflatten(treedef, reduced)
+                new_opt_tail = (jax.tree.unflatten(treedef, new_err),
+                                jax.tree.unflatten(treedef, new_serr))
+            if mode != "onebit":
                 # the post-exchange mean is materialized anyway: its norm is
                 # free, and gradient_clipping gets exact semantics
                 grad_norm = optax.global_norm(mean_g)
@@ -918,8 +1003,7 @@ class DeepSpeedEngine:
                 updates = jax.tree.map(
                     lambda u: (u * lr_factor).astype(u.dtype), updates)
                 new_params = optax.apply_updates(params, updates)
-                new_opt = (new_inner, jax.tree.unflatten(treedef, new_err),
-                           jax.tree.unflatten(treedef, new_serr))
+                new_opt = (new_inner,) + new_opt_tail
             return new_params, new_opt, grad_norm
 
         return jax.shard_map(

@@ -1,0 +1,30 @@
+"""Version shims for the jax API surface this repo targets.
+
+The codebase is written against the modern ``jax.shard_map`` entry point
+(keyword ``check_vma``). Older jax releases only ship
+``jax.experimental.shard_map.shard_map`` and spell the keyword
+``check_rep``. Importing this module (``deepspeed_tpu/__init__`` does it
+before anything else) installs a translating alias on the ``jax`` module
+so every call site — library, tests, benchmarks, and user code doing
+``from jax import shard_map`` — keeps the one modern spelling.
+"""
+
+import functools
+import inspect
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if "check_vma" in inspect.signature(_shard_map).parameters:
+        jax.shard_map = _shard_map
+    else:
+
+        @functools.wraps(_shard_map)
+        def _compat_shard_map(f, *args, check_vma=None, **kwargs):
+            if check_vma is not None:
+                kwargs.setdefault("check_rep", check_vma)
+            return _shard_map(f, *args, **kwargs)
+
+        jax.shard_map = _compat_shard_map

@@ -1,0 +1,136 @@
+"""Whole-process-group lifecycle: spawn children in their own group, reap
+the entire tree with TERM -> KILL escalation.
+
+Every parent in this codebase that holds child processes — the launcher's
+ssh fan-out (``launcher/runner.py``), the autotuner's local experiment
+relaunch (``autotuning/cli.py``), the dryrun harness's re-exec parent
+(``__graft_entry__.py``) — must go through these two helpers. The failure
+they close over: ``proc.terminate()`` signals only the direct child, so a
+child that forks (every JAX training script under a launcher does) or
+masks SIGTERM leaves grandchildren running after the parent gives up —
+the 21-hour leaked JAX child of ROADMAP item 1.
+
+Deliberately dependency-free (no jax, no package imports): importable
+from ``__graft_entry__`` before the toolchain is set up.
+"""
+
+import os
+import signal
+import subprocess
+import time
+from typing import Union
+
+__all__ = ["spawn_process_group", "reap_process_group"]
+
+
+def spawn_process_group(cmd, **popen_kwargs) -> subprocess.Popen:
+    """``subprocess.Popen`` with the child in its OWN session (hence its
+    own process group), so :func:`reap_process_group` can signal the whole
+    tree without touching the parent's group."""
+    popen_kwargs.setdefault("start_new_session", True)
+    return subprocess.Popen(cmd, **popen_kwargs)
+
+
+def _group_alive(pgid: int) -> bool:
+    try:
+        os.killpg(pgid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # members exist but aren't ours
+        return True
+
+
+def _signal_group(pgid: int, sig: int) -> None:
+    try:
+        os.killpg(pgid, sig)
+    except ProcessLookupError:
+        pass
+
+
+def _wait_group(proc: subprocess.Popen, pgid: int, timeout: float) -> bool:
+    """Wait for the whole group to vanish; returns True if it did. Always
+    reaps the direct child (``proc.wait``) so it can't linger as a zombie
+    that keeps the group 'alive'."""
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=max(remaining, 0.05))
+            except subprocess.TimeoutExpired:
+                return False
+        if not _group_alive(pgid):
+            return True
+        if remaining <= 0:
+            return False
+        time.sleep(min(0.05, max(remaining, 0.01)))
+
+
+def reap_process_group(proc: Union[subprocess.Popen, int],
+                       term_timeout: float = 10.0,
+                       kill_timeout: float = 10.0) -> str:
+    """TERM the child's process group; escalate to SIGKILL if anything in
+    it (the child included) survives ``term_timeout`` seconds.
+
+    ``proc`` is the ``Popen`` from :func:`spawn_process_group` (or a bare
+    pid for callers that lost the handle). Returns how the group died:
+    ``"exited"`` (already gone), ``"term"`` (SIGTERM sufficed), ``"kill"``
+    (SIGKILL needed), or ``"survived"`` (unkillable even by SIGKILL after
+    ``kill_timeout`` — caller should report, nothing more can be done).
+    Never raises for already-dead processes.
+    """
+    pid = proc if isinstance(proc, int) else proc.pid
+    try:
+        pgid = os.getpgid(pid)
+    except ProcessLookupError:
+        pgid = pid  # direct child gone; sweep whatever group it had
+    if pgid == os.getpgid(0):
+        # child shares OUR group (caller bypassed spawn_process_group):
+        # killpg would shoot this process too — fall back to the single pid
+        if isinstance(proc, subprocess.Popen):
+            if proc.poll() is not None:
+                return "exited"
+            proc.terminate()
+            try:
+                proc.wait(timeout=term_timeout)
+                return "term"
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=kill_timeout)
+                    return "kill"
+                except subprocess.TimeoutExpired:
+                    return "survived"
+        return "exited"
+
+    if isinstance(proc, subprocess.Popen):
+        already = proc.poll() is not None
+    else:
+        proc = None
+        already = False
+    if already and not _group_alive(pgid):
+        return "exited"
+
+    _signal_group(pgid, signal.SIGTERM)
+    if proc is not None:
+        if _wait_group(proc, pgid, term_timeout):
+            return "exited" if already else "term"
+    else:
+        deadline = time.monotonic() + term_timeout
+        while _group_alive(pgid) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if not _group_alive(pgid):
+            return "term"
+
+    _signal_group(pgid, signal.SIGKILL)
+    if proc is not None:
+        if _wait_group(proc, pgid, kill_timeout):
+            return "kill"
+    else:
+        deadline = time.monotonic() + kill_timeout
+        while _group_alive(pgid) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if not _group_alive(pgid):
+            return "kill"
+    return "survived"

@@ -34,6 +34,10 @@ import jax  # noqa: E402
 # startup; unit tests always run on the virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 
+# jax version shims (jax.shard_map spelling) must land before test modules
+# that do `from jax import shard_map` at import time are collected
+from deepspeed_tpu.utils import jax_compat  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 

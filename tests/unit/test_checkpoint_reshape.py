@@ -81,16 +81,19 @@ class TestReshapeMatrix:
         side uses stage 0 shardings; state is global either way)."""
         _resume_matches({"fsdp": 8}, {"dp": 8}, tmp_path, stage=0)
 
+    @pytest.mark.slow
     def test_zero3_fsdp_resize(self, eight_devices, tmp_path):
         """fsdp 8 -> fsdp 4 x dp 2, both ZeRO-3."""
         _resume_matches({"fsdp": 8}, {"fsdp": 4, "dp": 2}, tmp_path,
                         stage=3)
 
+    @pytest.mark.slow
     def test_tp_resize(self, eight_devices, tmp_path):
         """tp 2 -> tp 4 (Megatron specs re-applied at load)."""
         _resume_matches({"tp": 2, "dp": -1}, {"tp": 4, "dp": -1}, tmp_path,
                         save_micro=1, load_micro=2)
 
+    @pytest.mark.slow
     def test_ep_resize(self, eight_devices, tmp_path):
         """ep 4 -> ep 2 with expert-sharded checkpoint files (per-expert
         on disk, so the degree change re-shards on load)."""
@@ -120,6 +123,7 @@ class TestPipelineReshape:
             topology=topo, seed=seed)
         return engine, cfg, topo
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("pp_save,pp_load", [(4, 2), (2, 4)])
     def test_pp_reshape(self, eight_devices, tmp_path, pp_save, pp_load):
         """Layers saved at one pipeline degree load at another: global

@@ -1,0 +1,167 @@
+"""Engine-integrated bucketed/deferred gradient exchange
+(``tpu.grad_exchange`` config block -> ``_compressed_apply_core``).
+
+``deferred: true`` keeps per-worker grads through the accumulation window
+and exchanges once, bucketed, at the optimizer boundary — same protocol as
+the int8 path but with an fp32/bf16 wire, so it must match the baseline
+engine's math (exactly, for the fp32 wire). ``bucket_mb`` re-buckets the
+int8 exchange; ``bucket_mb: 0`` keeps the legacy per-leaf layout
+(checkpoint compatibility)."""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.config import (
+    DeepSpeedConfigError,
+    GradExchangeConfig,
+)
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+from tests.unit.test_engine_compressed import (
+    LSQ,
+    _compiled_step_text,
+    _data,
+    _engine,
+)
+
+
+def _params(eng):
+    return [np.asarray(x) for x in jax.tree.leaves(eng.params)]
+
+
+class TestGradExchangeConfig:
+    def test_defaults(self):
+        cfg = GradExchangeConfig.from_dict({})
+        assert cfg.bucket_mb == 0.0 and not cfg.deferred
+        assert cfg.wire_dtype == "bf16"
+
+    def test_rejects_bad_wire_dtype(self):
+        with pytest.raises(DeepSpeedConfigError, match="wire_dtype"):
+            GradExchangeConfig.from_dict({"wire_dtype": "fp8"})
+
+    def test_rejects_negative_bucket(self):
+        with pytest.raises(DeepSpeedConfigError, match="bucket_mb"):
+            GradExchangeConfig.from_dict({"bucket_mb": -1})
+
+    def test_engine_surfaces_config_error(self, eight_devices):
+        with pytest.raises(DeepSpeedConfigError, match="wire_dtype"):
+            _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                    extra={"tpu": {"grad_exchange":
+                                   {"wire_dtype": "int4"}}})
+
+
+class TestDeferredExchange:
+    def test_default_off(self, eight_devices):
+        eng = _engine({"type": "AdamW", "params": {"lr": 1e-2}})
+        assert eng._compressed_mode is None
+        assert eng._bucket_plan is None
+
+    def test_fp32_wire_matches_baseline_engine(self, eight_devices):
+        """The deferred exchange is psum-of-sums instead of
+        sum-of-psums — algebraically identical, and with the fp32 wire it
+        must track the baseline engine's parameters to float rounding."""
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        runs = {}
+        for name, extra in [
+            ("baseline", {}),
+            ("deferred", {"tpu": {"grad_exchange":
+                                  {"deferred": True, "wire_dtype": "fp32",
+                                   "bucket_mb": 1}}}),
+        ]:
+            from deepspeed_tpu.parallel import mesh
+            mesh.reset_default_topology()
+            eng = _engine({"type": "AdamW", "params": {"lr": 5e-2}},
+                          extra=extra, gas=2)
+            it = iter(RepeatingLoader([batch]))
+            losses = [float(eng.train_batch(it)) for _ in range(12)]
+            runs[name] = (losses, _params(eng), eng)
+        assert runs["deferred"][2]._compressed_mode == "deferred"
+        assert runs["deferred"][2]._bucket_plan is not None
+        np.testing.assert_allclose(runs["baseline"][0], runs["deferred"][0],
+                                   rtol=1e-4)
+        for b, d in zip(runs["baseline"][1], runs["deferred"][1]):
+            np.testing.assert_allclose(b, d, atol=1e-5)
+
+    def test_bf16_wire_converges_and_on_the_wire(self, eight_devices):
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine({"type": "AdamW", "params": {"lr": 5e-2}},
+                      extra={"tpu": {"grad_exchange": {"deferred": True}}})
+        it = iter(RepeatingLoader([batch]))
+        losses = [float(eng.train_batch(it)) for _ in range(100)]
+        assert losses[-1] < 0.01 * losses[0], losses[::20]
+        # the collective payload is cast to bf16 (the halved wire). The
+        # CPU backend then PROMOTES bf16 all-reduces back to f32 (no bf16
+        # collective support), so assert on the surviving bf16 converts
+        # that carry the psum metadata — on TPU the all-reduce itself
+        # stays bf16.
+        hlo = _compiled_step_text(eng, batch)
+        assert any("bf16[" in ln and "psum" in ln and "bucketed.py" in ln
+                   for ln in hlo.splitlines()), \
+            [ln for ln in hlo.splitlines() if "all-reduce" in ln][:4]
+
+    def test_grad_norm_available(self, eight_devices):
+        """Deferred mode materializes the averaged gradient, so the norm
+        (and clipping) work exactly as in the baseline engine."""
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                      extra={"tpu": {"grad_exchange": {"deferred": True}},
+                             "gradient_clipping": 1.0})
+        it = iter(RepeatingLoader([batch]))
+        eng.train_batch(it)
+        gn = eng.get_global_grad_norm()
+        assert gn is not None and np.isfinite(gn) and gn > 0, gn
+
+
+class TestBucketedInt8:
+    def test_converges_and_int8_wire(self, eight_devices):
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine({"type": "AdamW", "params": {"lr": 5e-2}},
+                      extra={"communication_data_type": "int8",
+                             "tpu": {"grad_exchange":
+                                     {"bucket_mb": 0.0001}}})
+        assert eng._compressed_mode == "int8"
+        it = iter(RepeatingLoader([batch]))
+        losses = [float(eng.train_batch(it)) for _ in range(100)]
+        assert losses[-1] < 0.01 * losses[0], losses[::20]
+        assert eng._bucket_plan is not None
+        hlo = _compiled_step_text(eng, batch)
+        assert re.search(r"(all-to-all|all-gather)[^\n]*s8"
+                         r"|s8[^\n]*(all-to-all|all-gather)", hlo)
+
+    def test_bucket_mb_zero_keeps_legacy_layout(self, eight_devices):
+        """No bucket budget -> the pre-bucketing per-leaf path and its
+        per-leaf error-feedback state layout (existing int8 checkpoints
+        keep loading)."""
+        X, Y = _data()
+        eng = _engine({"type": "AdamW", "params": {"lr": 5e-2}},
+                      extra={"communication_data_type": "int8"})
+        assert eng._compressed_mode == "int8"
+        assert eng._bucket_plan is None
+        it = iter(RepeatingLoader([{"x": X, "y": Y}]))
+        eng.train_batch(it)
+        # legacy state: worker-error tree mirrors the PARAM tree
+        assert len(jax.tree.leaves(eng._opt_state[1])) == \
+            len(jax.tree.leaves(eng.params))
+
+    def test_bucketed_error_feedback_state_per_bucket(self, eight_devices):
+        X, Y = _data()
+        eng = _engine({"type": "AdamW", "params": {"lr": 5e-2}},
+                      extra={"communication_data_type": "int8",
+                             "tpu": {"grad_exchange":
+                                     {"bucket_mb": 0.0001}}})
+        it = iter(RepeatingLoader([{"x": X, "y": Y}]))
+        for _ in range(3):
+            eng.train_batch(it)
+        plan = eng._bucket_plan
+        we = eng._opt_state[1]
+        assert isinstance(we, tuple) and len(we) == plan.num_buckets
+        # residuals are live (non-zero) after compressed steps
+        assert max(np.abs(np.asarray(e)).max() for e in we) > 0

@@ -488,6 +488,7 @@ class TestSparseRingKVCache:
         assert all(d == 32 for d in _cached_key_slot_dims(
             model, jnp.zeros((1, 8), jnp.int32)))
 
+    @pytest.mark.slow
     def test_ragged_ring_decode_matches_solo(self):
         model = self._sparse_model(
             {"mode": "local_sliding_window", "block": 16,
@@ -548,6 +549,7 @@ class TestSparseRingKVCache:
         assert all(d == eng.module.config.n_positions
                    for d in _cached_key_slot_dims(eng.module, ids))
 
+    @pytest.mark.slow
     def test_int8_composes_with_ring_cache(self):
         """Weight-only int8 serving and the ring KV cache engage in one
         model: the quantized block's in-scan dequant runs inside the ring
@@ -584,6 +586,7 @@ class TestSparseRingKVCache:
                                                           ids))
         np.testing.assert_array_equal(toks, ref_toks)
 
+    @pytest.mark.slow
     def test_streaming_decode_past_n_positions(self):
         """Ring-cached rotary models stream: no wpe table saturates, the
         ring evicts old window blocks, globals persist (attention sinks)
@@ -658,3 +661,76 @@ class TestDecodeDivergenceWarnings:
             pkg_logger.propagate = False
         assert any("DENSE" in r.message for r in caplog.records), \
             caplog.records
+
+
+class TestDemandedRingDeclines:
+    """sparse_kv_cache=True is a DEMAND: when the ring cache cannot engage,
+    ring_engaged must warn and record the reason instead of silently
+    decoding dense (sparse_attention_utils._decline_demanded_ring)."""
+
+    def _cfg_ns(self, sc, kv, n_positions):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(sparse_attention=sc, sparse_kv_cache=kv,
+                               n_positions=n_positions)
+
+    def _longformer(self):
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+            import get_sparse_attention_config
+
+        return get_sparse_attention_config(
+            {"mode": "bslongformer", "block": 16,
+             "num_sliding_window_blocks": 3,
+             "attention": "unidirectional"}, 4)
+
+    def test_too_small_n_positions_warns_and_records(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            sparse_attention_utils as sau)
+
+        sc = self._longformer()
+        n0 = len(sau.RING_DECLINES)
+        with pytest.warns(RuntimeWarning, match="DENSE"):
+            assert sau.ring_engaged(self._cfg_ns(sc, True, 32)) is None
+        assert len(sau.RING_DECLINES) == n0 + 1
+        assert "n_positions" in sau.RING_DECLINES[-1]
+
+    def test_inexpressible_layout_warns_with_reason(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            sparse_attention_utils as sau)
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+            import get_sparse_attention_config
+
+        # bidirectional window has no causal ring expression
+        sc = get_sparse_attention_config(
+            {"mode": "bslongformer", "block": 16,
+             "num_sliding_window_blocks": 3,
+             "attention": "bidirectional"}, 4)
+        n0 = len(sau.RING_DECLINES)
+        with pytest.warns(RuntimeWarning, match="no ring expression"):
+            assert sau.ring_engaged(self._cfg_ns(sc, True, 4096)) is None
+        assert len(sau.RING_DECLINES) == n0 + 1
+
+    def test_auto_decline_stays_silent(self):
+        import warnings as _warnings
+
+        from deepspeed_tpu.ops.sparse_attention import (
+            sparse_attention_utils as sau)
+
+        sc = self._longformer()
+        n0 = len(sau.RING_DECLINES)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            assert sau.ring_engaged(self._cfg_ns(sc, "auto", 32)) is None
+        assert len(sau.RING_DECLINES) == n0  # auto means "when it helps"
+
+    def test_engaged_ring_does_not_warn(self):
+        import warnings as _warnings
+
+        from deepspeed_tpu.ops.sparse_attention import (
+            sparse_attention_utils as sau)
+
+        sc = self._longformer()
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            ring = sau.ring_engaged(self._cfg_ns(sc, True, 4096))
+        assert ring is not None

@@ -257,6 +257,7 @@ def _spawn_pair(case, tmp_path):
     return per_proc
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("case", ["stage2", "stage3", "tp8", "sp_ring",
                                   "moe_ep"])
 def test_two_process_training_matches_single_host(case, eight_devices,
@@ -272,6 +273,7 @@ def test_two_process_training_matches_single_host(case, eight_devices,
     np.testing.assert_allclose(per_proc[0], losses_ref, rtol=1e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("case", ["infer_int8_tp8", "infer_moe_ep8"])
 def test_two_process_serving_matches_single_host(case, eight_devices,
                                                  tmp_path):

@@ -23,6 +23,7 @@ def dp_mesh():
 
 
 class TestCompressedAllreduce:
+    @pytest.mark.slow
     def test_error_feedback_converges(self, eight_devices):
         """Repeated compressed allreduce of the SAME tensor: error feedback
         must push the running average toward the exact mean."""
@@ -74,6 +75,7 @@ class TestCompressedAllreduce:
 
 
 class TestOnebitAdam:
+    @pytest.mark.slow
     def test_converges_close_to_adam(self, eight_devices):
         """Least squares on a dp mesh: after warmup the compressed stage
         must keep converging (loss comparable to exact Adam)."""
@@ -163,6 +165,7 @@ class TestOnebitCheckpointRoundTrip:
             b["labels"] = b["input_ids"]
         return engine, batches
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("save_at", [3, 9])  # mid-warmup / compressed
     def test_roundtrip_resumes_identically(self, eight_devices, tmp_path,
                                            save_at):
@@ -198,6 +201,7 @@ class TestOnebitCheckpointRoundTrip:
                    for j in range(4)]
         np.testing.assert_allclose(resumed, cont, rtol=1e-6, atol=0)
 
+    @pytest.mark.slow
     def test_fresh_engine_restore_continues_compressed(self, eight_devices,
                                                        tmp_path):
         """A true restart: a NEW engine (own jit cache, fresh buffers)

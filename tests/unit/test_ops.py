@@ -234,6 +234,7 @@ class TestFusedLinearCrossEntropy:
         assert _n_chunks(97, 32) == 97  # prime: falls back to size-1 chunks
         assert _n_chunks(64, 1024) == 1
 
+    @pytest.mark.slow
     def test_model_level_parity_tied_and_untied(self, eight_devices):
         """GPT loss/grads identical (to f32 tolerance) with the fused head
         on and off, tied and untied embeddings."""

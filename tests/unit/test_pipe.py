@@ -174,6 +174,7 @@ class TestPipelineEngine:
                  for x in jax.tree.leaves(p)]
         assert any("tp" in s for s in specs), specs
 
+    @pytest.mark.slow
     def test_curriculum_composes_with_pipeline(self, eight_devices):
         """Curriculum seqlen truncation rides into the 1F1B schedule: early
         steps train on truncated micro batches, difficulty reaches max,

@@ -1,0 +1,90 @@
+"""Process-group reaper (utils/procgroup.py): the whole child TREE dies,
+even when the direct child masks SIGTERM or has already exited — the
+launcher/autotuner/dryrun leak class of ROADMAP item 1."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deepspeed_tpu.utils.procgroup import (reap_process_group,
+                                           spawn_process_group)
+
+
+def _spawn(code):
+    proc = spawn_process_group([sys.executable, "-c", code],
+                               stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()  # wait until the child is set up
+    return proc, line
+
+
+def _gone(pid, timeout=10.0):
+    """True once pid no longer exists as a live (non-zombie) process."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rsplit(")", 1)[1].split()[0]
+            if state == "Z":
+                return True
+        except OSError:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_cooperative_child_dies_on_term():
+    proc, _ = _spawn("print('ready', flush=True); "
+                     "import time; time.sleep(120)")
+    assert reap_process_group(proc, term_timeout=10.0) == "term"
+    assert proc.poll() is not None
+
+
+def test_term_masking_child_is_reaped():
+    """The 21-hour leak: SIGTERM ignored must escalate to SIGKILL."""
+    proc, _ = _spawn(
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(120)\n")
+    t0 = time.monotonic()
+    assert reap_process_group(proc, term_timeout=1.0,
+                              kill_timeout=10.0) == "kill"
+    assert proc.poll() is not None
+    assert time.monotonic() - t0 < 30
+
+
+def test_grandchild_in_group_is_reaped():
+    """proc.terminate() only signals the direct child; the group reap must
+    take the TERM-masking grandchild with it."""
+    proc, line = _spawn(
+        "import subprocess, sys, time\n"
+        "g = subprocess.Popen([sys.executable, '-c',\n"
+        "    'import signal, time, os;'\n"
+        "    'signal.signal(signal.SIGTERM, signal.SIG_IGN);'\n"
+        "    'print(os.getpid(), flush=True); time.sleep(120)'],\n"
+        "    stdout=subprocess.PIPE, text=True)\n"
+        "print('g', g.stdout.readline().strip(), flush=True)\n"
+        "time.sleep(120)\n")
+    gpid = int(line.split()[1])
+    outcome = reap_process_group(proc, term_timeout=1.0, kill_timeout=10.0)
+    assert outcome in ("term", "kill")  # child dies to TERM; grandchild not
+    assert proc.poll() is not None
+    assert _gone(gpid), f"grandchild {gpid} survived the group reap"
+
+
+def test_already_exited_child_is_not_an_error():
+    proc, _ = _spawn("print('ready', flush=True)")
+    proc.wait(timeout=10)
+    assert reap_process_group(proc, term_timeout=1.0) == "exited"
+
+
+def test_bare_pid_of_dead_process():
+    proc, _ = _spawn("print('ready', flush=True)")
+    proc.wait(timeout=10)
+    pid = proc.pid
+    # handle lost: a bare pid of an already-reaped process must not raise
+    assert reap_process_group(pid, term_timeout=0.5,
+                              kill_timeout=0.5) in ("exited", "term", "kill")

@@ -377,6 +377,7 @@ class TestGPTSparseAttention:
             model=GPT(cfg), config=ds, seed=0)
         return engine, cfg
 
+    @pytest.mark.slow
     def test_trains_and_matches_dense_mode(self):
         engine, cfg = self._engine({"mode": "bigbird", "block": 16,
                                     "num_random_blocks": 1,
