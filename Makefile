@@ -16,6 +16,9 @@
 #                breakdown sums to step wall time, analytic MFU from the
 #                compiled step, and a perfetto-loadable trace
 #                (docs/observability.md)
+#   make serve-bench  continuous-batching vs sequential serving latency
+#                (TTFT / per-token / aggregate tok/s, CPU backend,
+#                commits benchmarks/inference/serving_bench_results.json)
 #   make check   test + smoke-if-hot-paths-changed — the full gate
 #   make hooks   install the committed .githooks (pre-push runs
 #                `make quick` + conditional smoke)
@@ -27,7 +30,7 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/ops deepspeed_tpu/utils/timer.py \
              deepspeed_tpu/inference/engine.py
 
-.PHONY: quick test smoke chaos profile check hooks hot-changed
+.PHONY: quick test smoke chaos profile check hooks hot-changed serve-bench
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
 # process-group units, with tests marked `slow` (pyproject marker) opted
@@ -39,7 +42,8 @@ quick:
 	  tests/unit/test_compressed_comm.py tests/unit/test_bucketed_comm.py \
 	  tests/unit/test_grad_exchange_modes.py \
 	  tests/unit/test_flash_autotune.py tests/unit/test_procgroup.py \
-	  tests/unit/test_launcher.py -q -x -m "not slow"
+	  tests/unit/test_launcher.py tests/unit/test_serving.py \
+	  -q -x -m "not slow"
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -52,6 +56,14 @@ chaos:
 
 profile:
 	$(PY) benchmarks/profile_step.py
+
+# continuous batching vs sequential generate: TTFT / per-token latency /
+# aggregate tokens/sec over >=16 concurrent streaming sequences at window
+# 512 (docs/performance.md "Serving"). Runs on the virtual CPU backend;
+# writes benchmarks/inference/serving_bench_results.json (a backend/mode
+# failure still writes a partial-result JSON and exits nonzero).
+serve-bench:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/inference/serving_bench.py
 
 # exits 0 when any hot-path file differs from BASE (override: `make
 # hot-changed BASE=<sha>` — the pre-push hook passes the remote sha so a

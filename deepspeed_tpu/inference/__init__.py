@@ -1,0 +1,9 @@
+from deepspeed_tpu.inference.engine import (InferenceEngine, init_inference,
+                                            prefill_chunk_spans)
+from deepspeed_tpu.inference.scheduler import (Completion,
+                                               ContinuousBatchingScheduler,
+                                               Request, ServingStats)
+
+__all__ = ["InferenceEngine", "init_inference", "prefill_chunk_spans",
+           "ContinuousBatchingScheduler", "Request", "Completion",
+           "ServingStats"]

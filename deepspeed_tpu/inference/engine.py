@@ -61,6 +61,32 @@ def _conform_host_quantized(host, shapes):
     return host
 
 
+def prefill_chunk_spans(model_cfg, T: int):
+    """Spans for an EXACT ring-cache prefill of a ``T``-token prompt.
+
+    Returns None when a single pass is already exact: dense-cache models
+    (no ring), or ``T <= ring_len`` from a fresh cache (no key is evicted
+    before every query of the pass has attended it). Otherwise returns
+    ``[(start, end), ...]`` block-aligned spans of at most ONE layout block
+    each: a mid-stream pass covering layout blocks ``[b0, b1]`` needs
+    blocks ``[b0 - w_blk .. b1]`` simultaneously ring-resident, and the
+    ring holds exactly ``w_blk + 1`` blocks, so ``b1 == b0`` — one block
+    per pass. The partial tail span stays inside one block, so it is exact
+    too. ``<= ring_len``-token passes per the model's prefill guard.
+    """
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
+        ring_engaged
+
+    ring = ring_engaged(model_cfg) if model_cfg is not None else None
+    if ring is None:
+        return None
+    w_blk, g_tok, blk = ring
+    ring_len = (w_blk + 1) * blk
+    if T <= ring_len:
+        return None
+    return [(s, min(s + blk, T)) for s in range(0, T, blk)]
+
+
 def init_inference(model, config: Optional[Dict[str, Any]] = None,
                    mp_size: int = 1, dtype=None, checkpoint: Optional[str] = None,
                    replace_with_kernel_inject: bool = True, seed: int = 0,
@@ -381,6 +407,15 @@ class InferenceEngine:
                 deterministic=True, decode=True, mutable=["cache"])
             return logits[:, -1], vars_out["cache"]
 
+        def prefill_more(params, ids, mask, cache):
+            # continuation pass of a chunked prefill: the cache already
+            # exists, this span's tokens append at the rows' cache_index
+            logits, vars_out = model.apply(
+                {"params": self._dequant(params), "cache": cache}, ids,
+                attention_mask=mask, deterministic=True, decode=True,
+                mutable=["cache"])
+            return logits[:, -1], vars_out["cache"]
+
         def one_token(params, token, cache, rng, temperature):
             # dequant HERE, inside the decode scan body: the int8->compute
             # convert fuses into the dots, so the per-token weight traffic
@@ -423,8 +458,28 @@ class InferenceEngine:
             return toks.swapaxes(0, 1), tok, cache, rng
 
         self._prefill_fn = jax.jit(prefill)
+        self._prefill_more_fn = jax.jit(prefill_more, donate_argnums=(3,))
         self._decode_k_fn = jax.jit(decode_k, static_argnums=(5,),
                                     donate_argnums=(2,))
+
+    def _chunked_prefill(self, input_ids, attention_mask):
+        """Prefill ``input_ids`` exactly: one pass when that is exact,
+        block-aligned ``<= ring_len``-token passes for prompts longer than
+        the ring (prefill_chunk_spans has the derivation). Returns
+        (last-token logits, cache); with LEFT-aligned prompts the final
+        span's last column is the last real token of every row."""
+        mcfg = getattr(self.module, "config", None)
+        spans = prefill_chunk_spans(mcfg, int(input_ids.shape[1]))
+        if spans is None:
+            return self._prefill_fn(self._params, input_ids, attention_mask)
+        s0, e0 = spans[0]
+        logits_last, cache = self._prefill_fn(
+            self._params, input_ids[:, s0:e0], attention_mask[:, s0:e0])
+        for s, e in spans[1:]:
+            logits_last, cache = self._prefill_more_fn(
+                self._params, input_ids[:, s:e], attention_mask[:, s:e],
+                cache)
+        return logits_last, cache
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, attention_mask=None):
@@ -460,8 +515,9 @@ class InferenceEngine:
                     "generate() on a sparse_attention-configured model: "
                     "this layout decodes with DENSE attention (training "
                     "was block-sparse); window/longformer layouts decode "
-                    "sparse-exactly via the ring KV cache — see "
-                    "docs/DIVERGENCES.md")
+                    "sparse-exactly via the ring KV cache — including "
+                    "prompts longer than the ring, which prefill in "
+                    "block-aligned chunks — see docs/DIVERGENCES.md")
         input_ids = jnp.asarray(input_ids)
         if attention_mask is not None:
             ids_np = np.asarray(input_ids)
@@ -513,8 +569,8 @@ class InferenceEngine:
             attention_mask = jnp.ones(input_ids.shape, jnp.bool_)
         input_ids = self._place_batch(input_ids)
         attention_mask = self._place_batch(attention_mask)
-        logits_last, cache = self._prefill_fn(self._params, input_ids,
-                                              attention_mask)
+        logits_last, cache = self._chunked_prefill(input_ids,
+                                                   attention_mask)
         rng, sub = jax.random.split(rng)
         if temperature > 0:
             tok = jax.random.categorical(

@@ -1,0 +1,363 @@
+"""Continuous-batching serving loop over the KV-cache decode path.
+
+``InferenceEngine.generate`` serves ONE fixed batch start-to-finish: every
+sequence waits for the slowest, and a finished lane burns decode FLOPs as
+padding until lockstep termination. Production serving (vLLM-style
+continuous batching; the reference's DeepSpeed-FastGen/MII serving layer)
+instead keeps a fixed-shape decode batch hot and swaps *sequences* through
+its lanes:
+
+* the decode step is ONE jitted ``[slots, 1]`` program, compiled once —
+  admissions and evictions never change its shape, so the hot loop never
+  recompiles (the CUDA-graph-replay discipline, applied to scheduling);
+* a finished sequence's lane is freed immediately and refilled from the
+  pending queue: admission runs an EXACT chunked prefill on a ``[1, Lp]``
+  batch (engine.prefill_chunk_spans — block-aligned passes keep every
+  chunk's window ring-resident) and splices the resulting cache into the
+  lane's cache rows with ``dynamic_update_slice`` — possible because the
+  model's decode caches carry PER-ROW clocks (``cache_index[B]``,
+  ``slot_pos[B, S]``), so one lane's time axis resets without touching its
+  neighbors;
+* completion is per-sequence (EOS or per-request max tokens), not
+  lockstep, and every emitted token fires a streaming callback.
+
+Free lanes keep decoding garbage tokens — attention is row-independent and
+the masked softmax is NaN-safe, so a garbage lane costs FLOPs but never
+contaminates a neighbor; its next admission overwrites every cache row it
+touched.
+
+Prompts are LEFT-padded to a ``prompt_bucket`` multiple to bound prefill
+compile count (bucket is a multiple of the layout block for ring models,
+so whole-block shifts preserve window visibility exactly; rotary positions
+are relative, ALiBi shifts are row-constant under softmax, and wpe reads
+the per-row semantic ``position`` counter — the same left-padding argument
+as ``generate``'s ragged path). Caveat, shared with that path: BSLongformer
+leading-global slots are PHYSICAL positions, so left-padding shifts real
+tokens out of the global region — serve those layouts through
+``generate``, or with bucket == prompt length.
+"""
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.engine import prefill_chunk_spans
+from deepspeed_tpu.parallel.mesh import set_default_topology
+
+
+@dataclass
+class Request:
+    """One sequence to serve: prompt token ids plus completion rules."""
+    prompt: Sequence[int]
+    max_new_tokens: int = 32
+    eos_token_id: Optional[int] = None
+    # called as callback(request_id, token_id, done) per emitted token
+    stream_callback: Optional[Callable[[int, int, bool], None]] = None
+    request_id: Optional[int] = None
+
+
+@dataclass
+class Completion:
+    """Result + latency telemetry for one served request."""
+    request_id: int
+    tokens: List[int]
+    prompt_len: int
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+
+    @property
+    def ttft_s(self) -> float:
+        """Time-to-first-token from submission (includes queue wait)."""
+        return self.t_first_token - self.t_submit
+
+    @property
+    def per_token_s(self) -> float:
+        """Mean inter-token latency after the first token."""
+        n = len(self.tokens)
+        if n <= 1:
+            return 0.0
+        return (self.t_done - self.t_first_token) / (n - 1)
+
+
+@dataclass
+class _Lane:
+    req: Request
+    comp: Completion
+    emitted: int = 0
+
+
+@dataclass
+class ServingStats:
+    completions: List[Completion] = field(default_factory=list)
+    wall_s: float = 0.0
+    decode_steps: int = 0
+
+    def summary(self) -> Dict[str, Any]:
+        ttfts = sorted(c.ttft_s for c in self.completions)
+        pts = [c.per_token_s for c in self.completions if len(c.tokens) > 1]
+        total_tokens = sum(len(c.tokens) for c in self.completions)
+
+        def pct(xs, q):
+            if not xs:
+                return 0.0
+            return float(xs[min(len(xs) - 1, int(q * len(xs)))])
+
+        return {
+            "num_sequences": len(self.completions),
+            "total_generated_tokens": total_tokens,
+            "wall_s": self.wall_s,
+            "aggregate_tokens_per_s": (total_tokens / self.wall_s
+                                       if self.wall_s > 0 else 0.0),
+            "ttft_s": {"mean": float(np.mean(ttfts)) if ttfts else 0.0,
+                       "p50": pct(ttfts, 0.50), "p95": pct(ttfts, 0.95)},
+            "per_token_ms": {
+                "mean": float(np.mean(pts)) * 1e3 if pts else 0.0,
+                "p50": pct(sorted(pts), 0.50) * 1e3,
+                "p95": pct(sorted(pts), 0.95) * 1e3},
+            "decode_steps": self.decode_steps,
+        }
+
+
+class ContinuousBatchingScheduler:
+    """Slot-based continuous batching over an ``InferenceEngine``.
+
+    ``submit()`` requests (before or during ``run()`` — a stream callback
+    may submit follow-ups), then ``run()`` drives admissions, the jitted
+    fixed-shape decode loop, per-sequence completion, and streaming
+    callbacks until the queue drains. Returns completions in finish order.
+    """
+
+    def __init__(self, engine, slots: int = 8,
+                 prompt_bucket: Optional[int] = None,
+                 temperature: float = 0.0,
+                 eos_token_id: Optional[int] = None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.engine = engine
+        self.slots = int(slots)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self._mcfg = getattr(engine.module, "config", None)
+
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+            import ring_engaged
+
+        self._ring = ring_engaged(self._mcfg) if self._mcfg is not None \
+            else None
+        if prompt_bucket is None:
+            prompt_bucket = self._ring[2] if self._ring is not None else 64
+        if self._ring is not None and prompt_bucket % self._ring[2] != 0:
+            raise ValueError(
+                f"prompt_bucket {prompt_bucket} must be a multiple of the "
+                f"ring layout block {self._ring[2]}: admission prefill "
+                "left-pads to the bucket, and only whole-block shifts "
+                "preserve the training window visibility exactly")
+        self.prompt_bucket = int(prompt_bucket)
+
+        # hard capacity for models whose decode cannot stream (dense cache
+        # or learned positions): prompt + generation must fit n_positions
+        self._max_pos = getattr(self._mcfg, "n_positions", None)
+        self._streaming = (self._ring is not None and
+                           not getattr(self._mcfg, "learned_positions", True))
+
+        self._pending: deque = deque()
+        self._next_id = 0
+        self._splice_fn = None
+        self._empty_cache_shapes = None
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               stream_callback: Optional[Callable] = None) -> int:
+        """Queue one request; returns its request id."""
+        prompt = list(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("an empty prompt cannot seed generation")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        bucketed = self._bucketed_len(len(prompt))
+        if self._max_pos is not None and not self._streaming and \
+                bucketed + max_new_tokens > self._max_pos:
+            raise ValueError(
+                f"bucketed prompt ({bucketed}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the KV cache capacity "
+                f"(n_positions={self._max_pos})")
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      eos_token_id=(self.eos_token_id if eos_token_id is None
+                                    else eos_token_id),
+                      stream_callback=stream_callback, request_id=rid)
+        self._pending.append((req, time.monotonic()))
+        return rid
+
+    def _bucketed_len(self, n: int) -> int:
+        b = self.prompt_bucket
+        return ((n + b - 1) // b) * b
+
+    # ------------------------------------------------------------------
+    def _ensure_compiled(self):
+        eng = self.engine
+        set_default_topology(eng.topology)
+        # the engine's param-shape init traces the TRAINING forward, whose
+        # sparse layout requires block-divisible T with at least the full
+        # window of blocks present (sparsity_config make_layout); param
+        # shapes don't depend on B or T, so one [1, T_probe] probe does
+        if eng._params is None or not hasattr(eng, "_param_shardings"):
+            t_probe = self.prompt_bucket
+            sc = getattr(self._mcfg, "sparse_attention", None)
+            nswb = getattr(sc, "num_sliding_window_blocks", None)
+            blk = getattr(sc, "block", None)
+            if nswb and blk:
+                t_probe = max(t_probe, int(nswb) * int(blk))
+            eng._materialize(
+                jnp.zeros((1, self._bucketed_len(t_probe)), jnp.int32))
+        if eng._prefill_fn is None:
+            eng._build_decode_fns()
+
+    def _empty_cache(self):
+        """A ``[slots]``-lane cache with every per-row clock at its virgin
+        value, WITHOUT running the model (a real apply would advance
+        ``cache_index``/``position`` and bake garbage into ``slot_pos``):
+        eval_shape the decode apply for the leaf geometry, then initialize
+        by name — ``slot_pos`` is -1 (no position cached), everything else
+        zeros (``valid`` bools are False, clocks are 0)."""
+        eng = self.engine
+        model = eng.module
+        probe = jnp.zeros((self.slots, 1), jnp.int32)
+
+        def shape_fn(params):
+            _, vars_out = model.apply(
+                {"params": eng._dequant(params)}, probe,
+                deterministic=True, decode=True, mutable=["cache"])
+            return vars_out["cache"]
+
+        shapes = jax.eval_shape(shape_fn, eng._params)
+
+        def init_leaf(path, sd):
+            name = path[-1].key if hasattr(path[-1], "key") else path[-1]
+            if name == "slot_pos":
+                return jnp.full(sd.shape, -1, sd.dtype)
+            return jnp.zeros(sd.shape, sd.dtype)
+
+        return jax.tree_util.tree_map_with_path(init_leaf, shapes)
+
+    def _splice(self, cache, sub_cache, lane):
+        """Write a freshly prefilled ``[1, ...]`` cache into batch lane
+        ``lane`` of the full cache. The batch axis differs per leaf (flax
+        nn.scan caches carry a leading layer axis: ``[L, B, ...]`` vs the
+        top-level ``position``/``cache_index`` at ``[B]``), so each leaf
+        locates its own first differing axis. Jitted once, lane traced."""
+        if self._splice_fn is None:
+
+            def splice(full, sub, lane_idx):
+                def one(f, s):
+                    if f.shape == s.shape:  # slots == 1
+                        return s
+                    ax = next(i for i, (a, b)
+                              in enumerate(zip(f.shape, s.shape)) if a != b)
+                    starts = tuple(lane_idx if i == ax else 0
+                                   for i in range(f.ndim))
+                    return jax.lax.dynamic_update_slice(f, s, starts)
+
+                return jax.tree.map(one, full, sub)
+
+            self._splice_fn = jax.jit(splice, donate_argnums=(0,))
+        return self._splice_fn(cache, sub_cache, jnp.int32(lane))
+
+    def _admit_prefill(self, req: Request):
+        """Exact (chunked when needed) prefill of one prompt on a
+        ``[1, Lp]`` batch; returns (first sampled token, sub cache)."""
+        eng = self.engine
+        Lp = self._bucketed_len(len(req.prompt))
+        ids = np.zeros((1, Lp), np.int32)
+        mask = np.zeros((1, Lp), bool)
+        ids[0, Lp - len(req.prompt):] = req.prompt
+        mask[0, Lp - len(req.prompt):] = True
+        logits_last, sub_cache = eng._chunked_prefill(
+            jnp.asarray(ids), jnp.asarray(mask))
+        eng._rng, sub = jax.random.split(eng._rng)
+        if self.temperature > 0:
+            tok = jax.random.categorical(
+                sub, logits_last / self.temperature, axis=-1)
+        else:
+            tok = jnp.argmax(logits_last, axis=-1)
+        return int(np.asarray(tok)[0]), sub_cache
+
+    # ------------------------------------------------------------------
+    def run(self) -> ServingStats:
+        """Serve the queue to completion; returns stats + completions."""
+        self._ensure_compiled()
+        eng = self.engine
+        stats = ServingStats()
+        lanes: List[Optional[_Lane]] = [None] * self.slots
+        tok = np.zeros((self.slots,), np.int32)
+        cache = self._empty_cache()
+        eng._rng, rng = jax.random.split(eng._rng)
+        temp = jnp.float32(self.temperature)
+        t_run0 = time.monotonic()
+
+        def finish(lane_no: int, lane: _Lane):
+            lane.comp.t_done = time.monotonic()
+            stats.completions.append(lane.comp)
+            lanes[lane_no] = None
+
+        def emit(lane_no: int, lane: _Lane, token: int) -> bool:
+            """Record one token; returns True when the sequence is done."""
+            now = time.monotonic()
+            lane.comp.tokens.append(token)
+            lane.emitted += 1
+            if lane.emitted == 1:
+                lane.comp.t_first_token = now
+            done = (lane.emitted >= lane.req.max_new_tokens
+                    or (lane.req.eos_token_id is not None
+                        and token == lane.req.eos_token_id))
+            if lane.req.stream_callback is not None:
+                lane.req.stream_callback(lane.req.request_id, token, done)
+            return done
+
+        while self._pending or any(l is not None for l in lanes):
+            # admissions: fill every free lane from the queue. A request
+            # that completes AT admission (max_new 1, or first token is
+            # EOS) frees its lane for the next pending request immediately.
+            for lane_no in range(self.slots):
+                while lanes[lane_no] is None and self._pending:
+                    req, t_submit = self._pending.popleft()
+                    comp = Completion(request_id=req.request_id, tokens=[],
+                                      prompt_len=len(req.prompt),
+                                      t_submit=t_submit)
+                    comp.t_admit = time.monotonic()
+                    first_tok, sub_cache = self._admit_prefill(req)
+                    cache = self._splice(cache, sub_cache, lane_no)
+                    tok[lane_no] = first_tok
+                    lane = _Lane(req=req, comp=comp)
+                    lanes[lane_no] = lane
+                    if emit(lane_no, lane, first_tok):
+                        finish(lane_no, lane)
+
+            if not any(l is not None for l in lanes):
+                continue  # everything admitted finished at token 1
+
+            # ONE fixed-shape decode step for all lanes (garbage lanes
+            # included — row-independent attention keeps them harmless)
+            toks, _, cache, rng = eng._decode_k_fn(
+                eng._params, jnp.asarray(tok), cache, rng, temp, 1)
+            stats.decode_steps += 1
+            tok = np.asarray(toks[:, 0]).astype(np.int32).copy()
+            for lane_no in range(self.slots):
+                lane = lanes[lane_no]
+                if lane is None:
+                    continue
+                if emit(lane_no, lane, int(tok[lane_no])):
+                    finish(lane_no, lane)
+
+        stats.wall_s = time.monotonic() - t_run0
+        return stats

@@ -385,6 +385,18 @@ class CausalSelfAttention(nn.Module):
                 w_blk, g_tok, blk = ring
                 ring_len = (w_blk + 1) * blk
                 S = g_tok + ring_len
+                if T > ring_len:
+                    raise ValueError(
+                        f"ring KV prefill got {T} tokens in one pass but "
+                        f"the ring retains only {ring_len} positions: keys "
+                        "a mid-prompt query still needs would be evicted "
+                        "before it attends, and the corrupted attention "
+                        "outputs would poison every later layer's cache "
+                        "(and with it every generated token). Prefill long "
+                        "prompts in block-aligned chunks instead — "
+                        "InferenceEngine.generate and the continuous-"
+                        "batching scheduler do this automatically "
+                        "(inference/engine.py prefill_chunk_spans).")
                 cached_k = self.variable(
                     "cache", "cached_key", jnp.zeros,
                     (B, S, Hkv, D), cfg.dtype)
@@ -393,35 +405,38 @@ class CausalSelfAttention(nn.Module):
                     (B, S, Hkv, D), cfg.dtype)
                 cache_valid = self.variable(
                     "cache", "valid", jnp.zeros, (B, S), jnp.bool_)
+                # PER-ROW slot positions and write index: continuous-
+                # batching admissions splice a freshly prefilled [1, ...]
+                # cache into one batch lane, so every row carries its own
+                # clock (lockstep generate just advances them together)
                 slot_pos = self.variable(
                     "cache", "slot_pos",
-                    lambda: jnp.full((S,), -1, jnp.int32))
+                    lambda: jnp.full((B, S), -1, jnp.int32))
                 cache_index = self.variable(
                     "cache", "cache_index",
-                    lambda: jnp.zeros((), jnp.int32))
-                idx = cache_index.value
-                pos = idx + jnp.arange(T)                     # [T]
+                    lambda: jnp.zeros((B,), jnp.int32))
+                idx = cache_index.value                       # [B]
+                pos = idx[:, None] + jnp.arange(T)[None, :]   # [B, T]
                 if cfg.rotary:
-                    q, k = rope(q, pos[None, :]), rope(k, pos[None, :])
-                # ring slot for every token; with T > ring_len only the
-                # last ring_len tokens may land (S is out-of-bounds ->
-                # scatter mode="drop"); leading-global tokens ALSO land in
-                # their dedicated slot (the ring copy is masked out of
+                    q, k = rope(q, pos), rope(k, pos)
+                # every token of a (guarded, <= ring_len) pass lands in its
+                # ring slot; leading-global tokens ALSO land in their
+                # dedicated slot (the ring copy is masked out of
                 # visibility below, so nothing double-counts)
-                ring_slot = jnp.where(pos >= idx + T - ring_len,
-                                      g_tok + pos % ring_len, S)
-                glob_slot = jnp.where(pos < g_tok, pos, S)
+                ring_slot = g_tok + pos % ring_len            # [B, T]
+                glob_slot = jnp.where(pos < g_tok, pos, S)    # S -> dropped
                 write_valid = (mask.astype(jnp.bool_) if mask is not None
                                else jnp.ones((B, T), jnp.bool_))
                 kc, vc = k.astype(cfg.dtype), v.astype(cfg.dtype)
+                rows = jnp.arange(B)[:, None]
                 for slots in (ring_slot, glob_slot):
-                    cached_k.value = cached_k.value.at[:, slots].set(
+                    cached_k.value = cached_k.value.at[rows, slots].set(
                         kc, mode="drop")
-                    cached_v.value = cached_v.value.at[:, slots].set(
+                    cached_v.value = cached_v.value.at[rows, slots].set(
                         vc, mode="drop")
                     cache_valid.value = cache_valid.value.at[
-                        :, slots].set(write_valid, mode="drop")
-                    slot_pos.value = slot_pos.value.at[slots].set(
+                        rows, slots].set(write_valid, mode="drop")
+                    slot_pos.value = slot_pos.value.at[rows, slots].set(
                         pos, mode="drop")
                 cache_index.value = idx + T
                 k_all, v_all = cached_k.value, cached_v.value
@@ -430,20 +445,19 @@ class CausalSelfAttention(nn.Module):
                 qg = q.reshape(B, T, Hkv, G, D)
                 scale = 1.0 / np.sqrt(D)
                 att = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all) * scale
-                q_pos = pos[:, None]                          # [T, 1]
-                ps = slot_pos.value[None, :]                  # [1, S]
-                s_idx = jnp.arange(S)[None, :]
+                q_pos = pos[:, :, None]                       # [B, T, 1]
+                ps = slot_pos.value[:, None, :]               # [B, 1, S]
+                s_idx = jnp.arange(S)[None, None, :]
                 is_glob = s_idx < g_tok
                 in_window = (ps // blk) >= (q_pos // blk) - w_blk
                 visible = ((ps >= 0) & (ps <= q_pos)
                            & (is_glob | (in_window & (ps >= g_tok))))
-                visible = (visible[None, None, None]          # [1,1,1,T,S]
+                visible = (visible[:, None, None]             # [B,1,1,T,S]
                            & cache_valid.value[:, None, None, None, :])
                 att = jnp.where(visible, att, jnp.finfo(att.dtype).min)
-                # NaN-safe: a prefill query older than the ring (its own
-                # key already evicted) has an empty visible set; its
-                # output is garbage by design (only the tail logits are
-                # consumed) but must not produce NaN
+                # NaN-safe: an all-pad chunk row (ragged left-padded batch)
+                # has an empty visible set; its output is masked out later
+                # but must not produce NaN
                 att = jax.nn.softmax(
                     att.astype(jnp.float32), axis=-1,
                     where=visible).astype(cfg.dtype)
@@ -471,24 +485,28 @@ class CausalSelfAttention(nn.Module):
             cache_valid = self.variable(
                 "cache", "valid", jnp.zeros,
                 (B, cfg.n_positions), jnp.bool_)
+            # PER-ROW write index (see ring branch): continuous-batching
+            # admissions splice a [1, ...] cache into one batch lane, so
+            # each row advances its own clock
             cache_index = self.variable(
                 "cache", "cache_index",
-                lambda: jnp.zeros((), jnp.int32))
-            idx = cache_index.value
+                lambda: jnp.zeros((B,), jnp.int32))
+            idx = cache_index.value                         # [B]
+            pos = idx[:, None] + jnp.arange(T)[None, :]     # [B, T]
             if cfg.rotary:
                 # rotate before the cache write: cached keys are
                 # position-baked, exactly like the reference's KV cache
                 # after its apply_rotary_pos_emb kernel
-                pos = idx + jnp.arange(T)[None, :]
                 q, k = rope(q, pos), rope(k, pos)
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            rows = jnp.arange(B)[:, None]
+            cached_k.value = cached_k.value.at[rows, pos].set(
+                k.astype(cfg.dtype), mode="drop")
+            cached_v.value = cached_v.value.at[rows, pos].set(
+                v.astype(cfg.dtype), mode="drop")
             write_valid = (mask.astype(jnp.bool_) if mask is not None
                            else jnp.ones((B, T), jnp.bool_))
-            cache_valid.value = jax.lax.dynamic_update_slice(
-                cache_valid.value, write_valid, (0, idx))
+            cache_valid.value = cache_valid.value.at[rows, pos].set(
+                write_valid, mode="drop")
             cache_index.value = idx + T
             k_all, v_all = cached_k.value, cached_v.value
 
@@ -499,14 +517,14 @@ class CausalSelfAttention(nn.Module):
             qg = q.reshape(B, T, Hkv, G, D)
             scale = 1.0 / np.sqrt(D)
             att = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all) * scale
-            q_pos = idx + jnp.arange(T)[:, None]            # [T, 1]
+            q_pos = pos[:, :, None]                         # [B, T, 1]
             k_pos = jnp.arange(cfg.n_positions)[None, :]    # [1, max]
             if cfg.alibi:
                 slopes = jnp.asarray(alibi_slopes(H)).reshape(Hkv, G)
                 att = att + (slopes[:, :, None, None]
                              * k_pos[None].astype(att.dtype))
-            visible = k_pos <= q_pos                        # causal over cache
-            visible = (visible[None, None, None]            # [1,1,1,T,max]
+            visible = (k_pos[None] <= q_pos)                # [B, T, max]
+            visible = (visible[:, None, None]               # [B,1,1,T,max]
                        & cache_valid.value[:, None, None, None, :])
             att = jnp.where(visible, att, jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(

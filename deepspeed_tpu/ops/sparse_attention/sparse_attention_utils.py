@@ -202,14 +202,13 @@ def ring_engaged(model_cfg):
                 f"layout {type(sc).__name__} has no ring expression")
         return None
     w_blk, g_tok, blk = ring
-    if g_tok + (w_blk + 1) * blk >= model_cfg.n_positions:
-        # ring would not be smaller than the dense cache
-        if demanded:
-            _decline_demanded_ring(
-                f"ring span {g_tok + (w_blk + 1) * blk} (global {g_tok} + "
-                f"window ({w_blk}+1) x block {blk}) >= n_positions "
-                f"{model_cfg.n_positions} — the compact cache would not be "
-                "smaller than dense")
+    if not demanded and g_tok + (w_blk + 1) * blk >= model_cfg.n_positions:
+        # "auto" means "ring only when it helps": a ring no smaller than
+        # the dense cache buys nothing, so auto silently declines.
+        # sparse_kv_cache=True is a DEMAND — the caller wants the ring's
+        # exact training-sparse decode math (and its chunked-prefill /
+        # streaming semantics) regardless of size, so True engages here;
+        # only layouts with no ring expression at all decline above.
         return None
     return ring
 

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Advanced serving compositions in one script.
 
-  python examples/serve_advanced.py --mode int8_tp    # int8 x tensor parallel
-  python examples/serve_advanced.py --mode moe_ep     # expert-parallel MoE
-  python examples/serve_advanced.py --mode streaming  # past-n_positions decode
+  python examples/serve_advanced.py --mode int8_tp     # int8 x tensor parallel
+  python examples/serve_advanced.py --mode moe_ep      # expert-parallel MoE
+  python examples/serve_advanced.py --mode streaming   # past-n_positions decode
+  python examples/serve_advanced.py --mode continuous  # continuous batching
 
 int8_tp:    weight-only int8 with the {q, scale} leaves sharded over tp
             (reference init_inference(mp_size=N, dtype=int8)).
@@ -14,6 +15,11 @@ streaming:  a window(+global)-trained rotary model decodes from the ring
             KV cache and generates PAST n_positions at O(window) memory
             (old window blocks evict; leading globals persist — the
             attention-sink pattern).
+continuous: the continuous-batching scheduler serves ragged requests
+            through a fixed pool of decode slots — a finished sequence's
+            lane is refilled by chunked-prefilling the next prompt while
+            the other lanes keep decoding; tokens stream per request as
+            they land (docs/performance.md "Serving").
 
 On one chip the tp/ep modes run with world size 1 (the sharding is a
 no-op); on a mesh they shard as annotated — the same script serves both.
@@ -31,7 +37,7 @@ import numpy as np
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--mode", default="streaming",
-                   choices=["int8_tp", "moe_ep", "streaming"])
+                   choices=["int8_tp", "moe_ep", "streaming", "continuous"])
     p.add_argument("--tokens", type=int, default=48)
     args = p.parse_args()
 
@@ -65,6 +71,36 @@ def main():
         ids = rng.randint(0, cfg.vocab_size,
                           size=(max(ep, 2), 64)).astype(np.int32)
         out = engine.generate(ids, max_new_tokens=args.tokens)
+    elif args.mode == "continuous":
+        from deepspeed_tpu.inference import ContinuousBatchingScheduler
+        from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
+            import apply_sparse_attention
+
+        cfg = GPTConfig(vocab_size=50257, n_positions=512, n_embd=256,
+                        n_layer=4, n_head=8, dtype=jnp.bfloat16,
+                        rotary=True, learned_positions=False)
+        model = apply_sparse_attention(
+            GPT(cfg), {"mode": "local_sliding_window", "block": 32,
+                       "num_sliding_window_blocks": 3})  # ring = 64 slots
+        engine = deepspeed_tpu.init_inference(model, dtype="bf16")
+        sched = ContinuousBatchingScheduler(engine, slots=4)
+
+        def stream(rid, token, done):
+            print(f"  req {rid}: token {token}{'  <done>' if done else ''}")
+
+        # ragged prompts, two of them LONGER than the 64-slot ring: those
+        # admissions prefill in exact block-aligned chunks
+        for n_prompt in (24, 80, 40, 150, 64, 96, 30, 55):
+            sched.submit(list(rng.randint(1, cfg.vocab_size, size=n_prompt)),
+                         max_new_tokens=min(args.tokens, 12),
+                         stream_callback=stream)
+        stats = sched.run()
+        s = stats.summary()
+        print(f"mode=continuous: {s['num_sequences']} sequences, "
+              f"{s['total_generated_tokens']} tokens in "
+              f"{s['wall_s']:.2f}s ({s['aggregate_tokens_per_s']:.1f} tok/s, "
+              f"{s['decode_steps']} batched decode steps) on {n} device(s)")
+        return
     else:  # streaming
         from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
             import apply_sparse_attention

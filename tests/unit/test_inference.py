@@ -683,16 +683,25 @@ class TestDemandedRingDeclines:
              "num_sliding_window_blocks": 3,
              "attention": "unidirectional"}, 4)
 
-    def test_too_small_n_positions_warns_and_records(self):
+    def test_demand_engages_oversized_ring(self):
+        """sparse_kv_cache=True DEMANDS the ring: a ring no smaller than
+        the dense cache still engages (the caller wants the exact
+        training-sparse decode math and streaming semantics, not a memory
+        win) — the size heuristic is reserved for "auto"."""
+        import warnings as _warnings
+
         from deepspeed_tpu.ops.sparse_attention import (
             sparse_attention_utils as sau)
 
         sc = self._longformer()
         n0 = len(sau.RING_DECLINES)
-        with pytest.warns(RuntimeWarning, match="DENSE"):
-            assert sau.ring_engaged(self._cfg_ns(sc, True, 32)) is None
-        assert len(sau.RING_DECLINES) == n0 + 1
-        assert "n_positions" in sau.RING_DECLINES[-1]
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            # ring span 16 + (1+1)*16 = 48 >= n_positions 32: auto would
+            # decline, True must engage — silently, it is not a fallback
+            ring = sau.ring_engaged(self._cfg_ns(sc, True, 32))
+        assert ring == (1, 16, 16)
+        assert len(sau.RING_DECLINES) == n0
 
     def test_inexpressible_layout_warns_with_reason(self):
         from deepspeed_tpu.ops.sparse_attention import (

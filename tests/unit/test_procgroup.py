@@ -88,3 +88,89 @@ def test_bare_pid_of_dead_process():
     # handle lost: a bare pid of an already-reaped process must not raise
     assert reap_process_group(pid, term_timeout=0.5,
                               kill_timeout=0.5) in ("exited", "term", "kill")
+
+
+# ---------------------------------------------------------------------------
+# dryrun evidence streaming (__graft_entry__._stream_with_phase_budget):
+# child stdout reaches the parent line-by-line WHILE it runs, so a budget
+# breach preserves every completed phase's evidence instead of destroying
+# the whole buffered transcript.
+# ---------------------------------------------------------------------------
+
+def _stream_child(code):
+    return spawn_process_group([sys.executable, "-u", "-c", code],
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True,
+                               bufsize=1)
+
+
+def _streamer():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    import __graft_entry__ as g
+    return g._stream_with_phase_budget
+
+
+def test_stream_happy_path_echoes_all_lines():
+    import io
+
+    stream = _streamer()
+    proc = _stream_child(
+        "for i in range(3):\n"
+        "    print(f'dryrun phase {i} ok')\n"
+        "print('dryrun_multichip(8) ok')\n")
+    buf = io.StringIO()
+    assert stream(proc, phase_budget_s=20.0, total_budget_s=60.0,
+                  out=buf) == 0
+    assert buf.getvalue().count("ok") == 4
+
+
+def test_stream_phase_breach_preserves_completed_evidence():
+    """A hang in phase 2 must still leave phase 1's line on the parent —
+    the exact evidence communicate(timeout=...) used to destroy."""
+    import io
+
+    stream = _streamer()
+    proc = _stream_child(
+        "import time\n"
+        "print('dryrun phase 1 ok')\n"
+        "print('entering phase 2')\n"
+        "time.sleep(120)\n")
+    buf = io.StringIO()
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="per-phase"):
+        stream(proc, phase_budget_s=1.0, total_budget_s=60.0, out=buf)
+    assert time.monotonic() - t0 < 30  # breach fired, not the sleep
+    assert "dryrun phase 1 ok" in buf.getvalue()
+    assert "entering phase 2" in buf.getvalue()
+    assert proc.poll() is not None  # child group reaped
+
+
+def test_stream_phase_marks_reset_the_phase_clock():
+    """Four 0.6s phases under a 1s per-phase budget: each 'phase ok' line
+    resets the clock, so the whole run passes despite 2.4s > 1s."""
+    import io
+
+    stream = _streamer()
+    proc = _stream_child(
+        "import time\n"
+        "for i in range(4):\n"
+        "    time.sleep(0.6)\n"
+        "    print(f'dryrun phase {i} ok')\n")
+    assert stream(proc, phase_budget_s=1.5, total_budget_s=60.0,
+                  out=io.StringIO()) == 0
+
+
+def test_stream_total_budget_backstops_phase_resets():
+    import io
+
+    stream = _streamer()
+    proc = _stream_child(
+        "import itertools, time\n"
+        "for i in itertools.count():\n"
+        "    time.sleep(0.2)\n"
+        "    print(f'dryrun phase {i} ok')\n")
+    buf = io.StringIO()
+    with pytest.raises(TimeoutError, match="total"):
+        stream(proc, phase_budget_s=10.0, total_budget_s=1.5, out=buf)
+    assert buf.getvalue().count("ok") >= 3  # streamed up to the breach
+    assert proc.poll() is not None
