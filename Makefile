@@ -19,6 +19,9 @@
 #   make serve-bench  continuous-batching vs sequential serving latency
 #                (TTFT / per-token / aggregate tok/s, CPU backend,
 #                commits benchmarks/inference/serving_bench_results.json)
+#   make data-bench  packed input pipeline: dataloader+h2d phase share
+#                with background prefetch off vs on (commits
+#                benchmarks/data/input_pipeline_bench_results.json)
 #   make check   test + smoke-if-hot-paths-changed — the full gate
 #   make hooks   install the committed .githooks (pre-push runs
 #                `make quick` + conditional smoke)
@@ -30,7 +33,8 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/ops deepspeed_tpu/utils/timer.py \
              deepspeed_tpu/inference/engine.py
 
-.PHONY: quick test smoke chaos profile check hooks hot-changed serve-bench
+.PHONY: quick test smoke chaos profile check hooks hot-changed serve-bench \
+        data-bench
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
 # process-group units, with tests marked `slow` (pyproject marker) opted
@@ -43,6 +47,7 @@ quick:
 	  tests/unit/test_grad_exchange_modes.py \
 	  tests/unit/test_flash_autotune.py tests/unit/test_procgroup.py \
 	  tests/unit/test_launcher.py tests/unit/test_serving.py \
+	  tests/unit/test_data_pipeline.py \
 	  -q -x -m "not slow"
 
 test:
@@ -64,6 +69,13 @@ profile:
 # failure still writes a partial-result JSON and exits nonzero).
 serve-bench:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/inference/serving_bench.py
+
+# packed input pipeline: dataloader+h2d share of step time with
+# data_pipeline.prefetch off vs on (docs/data.md). Writes
+# benchmarks/data/input_pipeline_bench_results.json; exits nonzero when
+# prefetch fails to reduce the input share.
+data-bench:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/data/input_pipeline_bench.py
 
 # exits 0 when any hot-path file differs from BASE (override: `make
 # hot-changed BASE=<sha>` — the pre-push hook passes the remote sha so a

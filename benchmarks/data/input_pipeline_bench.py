@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Input-pipeline benchmark (``make data-bench``, docs/data.md).
+
+Trains the same tiny GPT twice over a packed variable-length document
+stream — ``data_pipeline.prefetch`` OFF then ON — with the step profiler
+fencing every phase, and compares the share of step wall time spent in
+input (dataloader + h2d). With prefetch on, the worker thread packs the
+next batch and runs the sharded ``device_put`` while the compiled step
+of the previous batch executes, so both phases should collapse toward
+zero at consume time.
+
+To make the comparison honest on a fast CPU model, the document stream
+carries a small synthetic per-batch tokenization cost (``WORK_MS`` of
+numpy busy-work per document), standing in for the real decode/augment
+cost that production loaders pay. Without it the tiny model's input
+share is noise on a laptop.
+
+Writes ``benchmarks/data/input_pipeline_bench_results.json`` (committed,
+like the serving and smoke benches) and prints the same JSON; exits
+nonzero when prefetch does NOT reduce the input share — a partial
+result file is still written so regressions leave evidence.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+if "JAX_PLATFORMS" not in os.environ:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig  # noqa: E402
+
+SEQ = 128
+MICRO = 2
+WINDOW_START = 3
+WINDOW_STEPS = 8
+WORK_MS = 2.0  # synthetic per-document tokenization cost
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "input_pipeline_bench_results.json")
+
+
+class SlowDocs:
+    """Variable-length docs with a fixed busy-wait per fetch, standing in
+    for tokenization/decode work a real corpus reader would do."""
+
+    def __init__(self, n=4096, vocab=1024, seed=0):
+        rng = np.random.RandomState(seed)
+        self._docs = [
+            rng.randint(1, vocab, size=rng.randint(24, 96)).astype(np.int32)
+            for _ in range(n)
+        ]
+
+    def __len__(self):
+        return len(self._docs)
+
+    def __getitem__(self, i):
+        deadline = time.perf_counter() + WORK_MS / 1e3
+        x = 0.0
+        while time.perf_counter() < deadline:
+            x += float(np.dot(np.arange(256.0), np.arange(256.0)))
+        return {"input_ids": self._docs[i]}
+
+
+def run(prefetch: bool) -> dict:
+    cfg = GPTConfig(vocab_size=1024, n_positions=SEQ, n_embd=128,
+                    n_layer=2, n_head=4, dtype=jnp.float32,
+                    param_dtype=jnp.float32)
+    ds = {
+        "train_micro_batch_size_per_gpu": MICRO,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+        "data_pipeline": {
+            "enabled": True,
+            "seq_length": SEQ,
+            "seed": 0,
+            "prefetch": prefetch,
+            "prefetch_depth": 2,
+        },
+        "step_profiler": {
+            "enabled": True,
+            "start_step": WINDOW_START,
+            "num_steps": WINDOW_STEPS,
+        },
+    }
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=GPT(cfg), config=ds, training_data=SlowDocs())
+    it = iter(loader)
+    for _ in range(WINDOW_START + WINDOW_STEPS + 1):
+        engine.train_batch(it)
+    summary = engine.step_profiler.summary()
+    counters = engine.step_profiler.perf_counters()
+    if hasattr(loader, "stop"):
+        loader.stop()
+
+    phases = summary.get("phases_ms", {})
+    step_ms = summary.get("step_time_ms", {}).get("mean", 0.0)
+    input_ms = phases.get("dataloader", 0.0) + phases.get("h2d", 0.0)
+    return {
+        "prefetch": prefetch,
+        "steps_profiled": summary.get("steps_profiled"),
+        "step_time_ms_mean": step_ms,
+        "dataloader_ms": phases.get("dataloader", 0.0),
+        "h2d_ms": phases.get("h2d", 0.0),
+        "compiled_step_ms": phases.get("compiled_step", 0.0),
+        "input_share": (input_ms / step_ms) if step_ms else 0.0,
+        "prefetch_counters": {k: v for k, v in counters.items()
+                              if k.startswith("prefetch_")},
+    }
+
+
+def main() -> int:
+    results = {
+        "config": {"seq": SEQ, "micro_batch": MICRO,
+                   "window_steps": WINDOW_STEPS,
+                   "synthetic_doc_work_ms": WORK_MS},
+        "runs": {},
+        "ok": False,
+    }
+    failures = []
+    try:
+        off = run(prefetch=False)
+        results["runs"]["prefetch_off"] = off
+        on = run(prefetch=True)
+        results["runs"]["prefetch_on"] = on
+        results["input_share_off"] = off["input_share"]
+        results["input_share_on"] = on["input_share"]
+        results["input_share_reduction"] = (
+            off["input_share"] - on["input_share"])
+        results["step_time_speedup"] = (
+            off["step_time_ms_mean"] / on["step_time_ms_mean"]
+            if on["step_time_ms_mean"] else 0.0)
+        if on["input_share"] >= off["input_share"]:
+            failures.append(
+                f"prefetch did not reduce input share: "
+                f"off={off['input_share']:.3f} on={on['input_share']:.3f}")
+        if not on["prefetch_counters"].get("prefetch_gets"):
+            failures.append("prefetch counters missing from perf_counters")
+    except Exception as e:  # partial results still land on disk
+        failures.append(f"{type(e).__name__}: {e}")
+
+    results["ok"] = not failures
+    results["failures"] = failures
+    with open(RESULTS, "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(results, indent=2, sort_keys=True))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

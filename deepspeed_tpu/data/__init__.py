@@ -1,0 +1,40 @@
+"""Input data pipeline: deterministic sharded streaming, sequence packing,
+and double-buffered host->device prefetch (docs/data.md).
+
+The training input path this package replaces is the synchronous host loop
+in ``runtime/dataloader.py`` + ``runtime/engine.py``: ``next(data_iter)``
+followed by a blocking ``device_put`` inside the step. Here the three
+stages are separated so each is independently testable and the transfer
+overlaps compute:
+
+* :class:`ShardedSampleStream` — deterministic, seed+epoch-keyed sample
+  order, disjointly sharded across data-parallel processes, resumable via
+  ``state_dict`` and reshuffled by the sentinel's ``reseed`` path.
+* :class:`SequencePacker` — greedy first-fit bin packing of variable
+  length documents into fixed ``[B, S]`` batches with ``segment_ids`` and
+  per-segment position resets (the exactness contract the model's
+  segment-aware masking completes; see docs/data.md).
+* :class:`DevicePrefetcher` — a bounded background queue whose worker
+  runs the engine's sharded ``device_put``, so h2d of batch N+1 overlaps
+  compute of batch N.
+* :class:`PackedDataPipeline` — the loader-protocol object tying the
+  stream and packer together (``state_dict``/``load_state_dict``/
+  ``reseed``/``order_version``, same contract as ``DeepSpeedDataLoader``).
+
+Config-gated under the ``data_pipeline`` block (``runtime/config.py``),
+default-off: without it the engine's input path is byte-identical to the
+historical loop.
+"""
+
+from deepspeed_tpu.data.packing import SequencePacker, pack_documents
+from deepspeed_tpu.data.pipeline import PackedDataPipeline
+from deepspeed_tpu.data.prefetch import DevicePrefetcher
+from deepspeed_tpu.data.streaming import ShardedSampleStream
+
+__all__ = [
+    "DevicePrefetcher",
+    "PackedDataPipeline",
+    "SequencePacker",
+    "ShardedSampleStream",
+    "pack_documents",
+]

@@ -1,0 +1,186 @@
+"""Background host→device prefetch: a bounded queue whose worker thread
+pulls batches from the wrapped loader and runs the engine's sharded
+``device_put`` *before* the training loop asks for them.
+
+With depth ≥ 2 this double-buffers the input path: while the compiled
+step for batch N runs, the worker is already staging batch N+1's host
+copy and device transfer, so the step-profiler's ``dataloader`` and
+``h2d`` phases collapse to a queue pop (see
+``benchmarks/data/input_pipeline_bench.py``).
+
+Resume correctness: the worker captures ``loader.state_dict()``
+immediately after pulling each item and enqueues the pair
+``(device_batch, state)``. When the consumer pops batch *k*, the state
+that rides with it is exactly "the loader just after producing batch
+*k*" — i.e. the correct resume point once batch *k* has been consumed —
+regardless of how far ahead the worker has run. ``state_dict()`` returns
+that last-delivered snapshot, so checkpoints taken between steps restore
+without replaying or skipping prefetched-but-unconsumed batches.
+"""
+
+import copy
+import queue
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_END = object()  # worker→consumer: wrapped loader raised StopIteration
+
+
+class DevicePrefetcher:
+    """Wrap a loader-protocol iterator with a bounded prefetch queue.
+
+    ``put_fn`` is the host→device transfer (the engine passes its
+    ``_put_batch``); ``None`` leaves batches on host. The wrapper itself
+    speaks the loader protocol (``state_dict``/``load_state_dict``/
+    ``reseed``/``order_version``/``seed``) by delegating to the wrapped
+    loader — mutating calls halt the worker first so the underlying
+    iterator is never touched from two threads.
+    """
+
+    def __init__(self, loader, put_fn: Optional[Callable] = None,
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.loader = loader
+        self.put_fn = put_fn
+        self.depth = depth
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._delivered_state: Optional[Dict[str, Any]] = None
+        self._last_order_version = getattr(loader, "order_version", 0)
+        # starvation accounting for Perf/* counters
+        self._gets = 0
+        self._starved_gets = 0
+        self._depth_sum = 0
+        self._depth_max = 0
+
+    # -- worker ------------------------------------------------------------
+    def _worker(self, it):
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = next(it)
+                except StopIteration:
+                    self._put_blocking(_END)
+                    return
+                state = None
+                if hasattr(self.loader, "state_dict"):
+                    state = copy.deepcopy(self.loader.state_dict())
+                if self.put_fn is not None:
+                    item = self.put_fn(item)
+                if not self._put_blocking((item, state)):
+                    return
+        except BaseException as e:  # propagate into the consumer
+            self._error = e
+            self._put_blocking(_END)
+
+    def _put_blocking(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _ensure_worker(self):
+        if getattr(self.loader, "order_version", 0) != self._last_order_version:
+            self._halt()
+        if self._thread is None or not self._thread.is_alive():
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            self._stop.clear()
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._last_order_version = getattr(self.loader,
+                                               "order_version", 0)
+            self._thread = threading.Thread(
+                target=self._worker, args=(iter(self.loader),),
+                name="ds-prefetch", daemon=True)
+            self._thread.start()
+
+    def _halt(self):
+        """Stop the worker and discard anything it staged."""
+        self._stop.set()
+        if self._thread is not None:
+            # drain so a blocked put() observes the stop event
+            while self._thread.is_alive():
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    self._thread.join(timeout=0.1)
+            self._thread = None
+        with self._queue.mutex:
+            self._queue.queue.clear()
+        self._stop.clear()
+
+    # -- iterator ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._ensure_worker()
+        depth = self._queue.qsize()
+        self._gets += 1
+        self._depth_sum += depth
+        self._depth_max = max(self._depth_max, depth)
+        if depth == 0:
+            self._starved_gets += 1
+        got = self._queue.get()
+        if got is _END:
+            self._thread = None
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            raise StopIteration
+        item, state = got
+        if state is not None:
+            self._delivered_state = state
+        return item
+
+    def counters(self) -> Dict[str, float]:
+        """Prefetch health counters, exported as ``Perf/*`` gauges by the
+        step profiler (see docs/observability.md)."""
+        gets = max(self._gets, 1)
+        return {
+            "prefetch_depth": float(self.depth),
+            "prefetch_gets": float(self._gets),
+            "prefetch_starved_gets": float(self._starved_gets),
+            "prefetch_queue_depth_avg": self._depth_sum / gets,
+            "prefetch_queue_depth_max": float(self._depth_max),
+        }
+
+    def stop(self):
+        self._halt()
+
+    # -- loader protocol ---------------------------------------------------
+    @property
+    def order_version(self) -> int:
+        return getattr(self.loader, "order_version", 0)
+
+    @property
+    def seed(self):
+        return getattr(self.loader, "seed", None)
+
+    @property
+    def batch_size(self):
+        return getattr(self.loader, "batch_size", None)
+
+    def reseed(self, offset: int):
+        self._halt()
+        self._delivered_state = None
+        self.loader.reseed(offset)
+        self._last_order_version = getattr(self.loader, "order_version", 0)
+
+    def state_dict(self) -> Dict[str, Any]:
+        if self._delivered_state is not None:
+            return copy.deepcopy(self._delivered_state)
+        return copy.deepcopy(self.loader.state_dict())
+
+    def load_state_dict(self, state: Dict[str, Any]):
+        self._halt()
+        self._delivered_state = None
+        self.loader.load_state_dict(state)
+        self._last_order_version = getattr(self.loader, "order_version", 0)

@@ -1,0 +1,104 @@
+"""Deterministic, seed+epoch-keyed, DP-sharded streaming sample source.
+
+The MLPerf TPU-v3 pods work makes deterministic sharded input order a
+correctness requirement at scale-out: every process must be able to
+recompute exactly which samples it owns from ``(seed, epoch)`` alone, so a
+restore (or an elastic restart on a different host) replays the identical
+stream. This source keeps the ``DeepSpeedDataLoader`` idiom — a fresh
+``np.random.RandomState(seed + epoch)`` permutation per epoch — and adds
+the two things the batch-level loader cannot express:
+
+* **sharding**: shard ``r`` of ``n`` owns ``order[r::n]`` truncated to the
+  common length, so shards are disjoint and equally sized in every epoch;
+* **mid-epoch resume**: ``state_dict`` carries a sample cursor, not just
+  ``(epoch, seed)``, so a restore continues from the exact next document.
+
+``reseed(offset)`` derives a fresh order (seed = base + offset) and
+restarts the epoch traversal — the sentinel's rollback re-entry path:
+replaying the exact stream that diverged once would diverge again.
+"""
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+class ShardedSampleStream:
+    """Infinite iterator over a map-style dataset in a deterministic,
+    sharded, per-epoch-shuffled order.
+
+    ``next(stream)`` returns one sample and advances the cursor; epoch
+    boundaries are internal (the order is rebuilt, ``epoch`` increments).
+    """
+
+    def __init__(self, dataset, *, shuffle: bool = True, seed: int = 0,
+                 shard_rank: int = 0, num_shards: int = 1):
+        if num_shards < 1 or not (0 <= shard_rank < num_shards):
+            raise ValueError(
+                f"invalid shard {shard_rank}/{num_shards}")
+        if len(dataset) < num_shards:
+            raise ValueError(
+                f"dataset of {len(dataset)} samples cannot be split into "
+                f"{num_shards} non-empty shards")
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self._base_seed = int(seed)
+        self.shard_rank = shard_rank
+        self.num_shards = num_shards
+        self.epoch = 0
+        self.cursor = 0  # samples already drawn from this shard this epoch
+        # bumped whenever the order changes out-of-band (reseed or
+        # load_state_dict) so downstream stages can restart/flush
+        self.order_version = 0
+        self._order = None
+        self._order_key = None
+
+    @property
+    def samples_per_epoch(self) -> int:
+        """Per-shard epoch length (the common truncated length)."""
+        return len(self.dataset) // self.num_shards
+
+    def _epoch_order(self) -> np.ndarray:
+        key = (self.seed, self.epoch)
+        if self._order_key != key:
+            order = np.arange(len(self.dataset))
+            if self.shuffle:
+                np.random.RandomState(self.seed + self.epoch).shuffle(order)
+            # interleaved shard, truncated to the common length: disjoint
+            # across ranks, equal-sized, and a pure function of (seed, epoch)
+            self._order = order[self.shard_rank::self.num_shards][
+                :self.samples_per_epoch]
+            self._order_key = key
+        return self._order
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        order = self._epoch_order()
+        if self.cursor >= len(order):
+            self.epoch += 1
+            self.cursor = 0
+            order = self._epoch_order()
+        sample = self.dataset[int(order[self.cursor])]
+        self.cursor += 1
+        return sample
+
+    # -- loader protocol (see runtime/dataloader.py) -----------------------
+    def reseed(self, offset: int):
+        """Fresh deterministic order: seed = base seed + offset, epoch
+        traversal restarted."""
+        self.seed = self._base_seed + int(offset)
+        self.cursor = 0
+        self.order_version += 1
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"seed": self.seed, "epoch": self.epoch,
+                "cursor": self.cursor}
+
+    def load_state_dict(self, state: Dict[str, int]):
+        self.seed = int(state.get("seed", self.seed))
+        self.epoch = int(state.get("epoch", self.epoch))
+        self.cursor = int(state.get("cursor", self.cursor))
+        self.order_version += 1

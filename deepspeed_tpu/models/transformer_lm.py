@@ -341,12 +341,33 @@ class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True, decode=False):
+    def __call__(self, x, *, mask=None, segment_ids=None, positions=None,
+                 deterministic=True, decode=False):
         cfg = self.config
         B, T, C = x.shape
         H, D = cfg.n_head, cfg.head_dim
         Hkv = cfg.kv_heads
         bias = cfg.use_bias if cfg.attn_bias is None else cfg.attn_bias
+
+        # packed-sequence masking (deepspeed_tpu/data/): position i attends
+        # j iff j <= i AND seg[i] == seg[j]. Supported on the flash and
+        # einsum paths; the others either cannot express the per-row block
+        # structure (sparse layouts, ALiBi's absolute-position bias) or do
+        # not see it yet (sp/chunked fall through to einsum below).
+        if segment_ids is not None:
+            if decode:
+                raise NotImplementedError(
+                    "packed-sequence segment_ids are a training-path "
+                    "feature; decode caches are per-sequence")
+            if cfg.sparse_attention is not None:
+                raise NotImplementedError(
+                    "segment_ids with a block-sparse layout would silently "
+                    "change the layout's visibility; unpack the batch or "
+                    "disable sparse_attention")
+            if cfg.alibi:
+                raise NotImplementedError(
+                    "ALiBi's absolute-position bias is not segment-aware; "
+                    "packed batches require rotary or learned positions")
 
         qkv = nn.Dense((H + 2 * Hkv) * D, use_bias=bias,
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -535,8 +556,12 @@ class CausalSelfAttention(nn.Module):
                             param_dtype=cfg.param_dtype, name="c_proj")(y)
 
         if cfg.rotary:
-            q = rope(q, jnp.arange(T)[None, :])
-            k = rope(k, jnp.arange(T)[None, :])
+            # packed batches pass per-segment-reset positions so each
+            # document sees the same rotary phases it would alone
+            pos = (positions if positions is not None
+                   else jnp.arange(T)[None, :])
+            q = rope(q, pos)
+            k = rope(k, pos)
         k = repeat_kv(k)
         v = repeat_kv(v)
 
@@ -563,7 +588,7 @@ class CausalSelfAttention(nn.Module):
         # like the flash path, sp attention has no attention-prob dropout
         # (and no ALiBi bias hook)
         if (cfg.sequence_parallel != "none" and mask is None
-                and not cfg.alibi
+                and segment_ids is None and not cfg.alibi
                 and (cfg.dropout == 0.0 or deterministic)):
             from deepspeed_tpu.parallel.mesh import get_default_topology
             from deepspeed_tpu.parallel.sequence import (
@@ -592,7 +617,8 @@ class CausalSelfAttention(nn.Module):
                 (c for c in (CHUNKED_AUTO_CHUNK, 512, 256, 128)
                  if T % c == 0), None)
         eff_chunk = cfg.attention_chunk or auto_chunk
-        if (eff_chunk and mask is None and not cfg.alibi
+        if (eff_chunk and mask is None and segment_ids is None
+                and not cfg.alibi
                 and (cfg.dropout == 0.0 or deterministic)
                 and T % eff_chunk == 0 and T > eff_chunk):
             from deepspeed_tpu.ops.chunked_attention import chunked_attention
@@ -620,6 +646,7 @@ class CausalSelfAttention(nn.Module):
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
             y = flash_attention(q, k, v, causal=cfg.causal,
+                                segment_ids=segment_ids,
                                 autotune=True if cfg.flash_autotune
                                 else None)
         else:
@@ -638,6 +665,12 @@ class CausalSelfAttention(nn.Module):
                                 jnp.finfo(att.dtype).min)
             if mask is not None:
                 att = jnp.where(mask[:, None, None, :], att, jnp.finfo(att.dtype).min)
+            if segment_ids is not None:
+                # NaN-safe: the causal diagonal is always same-segment, so
+                # no row's visible set is ever empty
+                same = (segment_ids[:, None, :, None]
+                        == segment_ids[:, None, None, :])
+                att = jnp.where(same, att, jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
             att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
@@ -678,13 +711,14 @@ class Block(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True, decode=False,
-                 pld_keep=None):
+    def __call__(self, x, *, mask=None, segment_ids=None, positions=None,
+                 deterministic=True, decode=False, pld_keep=None):
         cfg = self.config
         x_in = x
         a = CausalSelfAttention(cfg, name="attn")(
             _norm(cfg, "ln_1")(x),
-            mask=mask, deterministic=deterministic, decode=decode)
+            mask=mask, segment_ids=segment_ids, positions=positions,
+            deterministic=deterministic, decode=decode)
         if cfg.parallel_residual:
             # GPT-J/NeoX form: attention and MLP both read the pre-residual
             # stream; GPT-J's single shared LN is expressed by loading
@@ -861,18 +895,19 @@ class ScannedBlocks(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, mask=None, deterministic=True, decode=False,
-                 pld_theta=None):
+    def __call__(self, x, *, mask=None, segment_ids=None, positions=None,
+                 deterministic=True, decode=False, pld_theta=None):
         cfg = self.config
         use_pld = (cfg.stochastic_mode and pld_theta is not None
                    and not deterministic)
 
-        def call_block(block, x, mask, layer_idx):
+        def call_block(block, x, mask, segment_ids, positions, layer_idx):
             # deterministic/decode ride the closure so remat never sees
             # them as traced booleans
             pld_keep = (pld_keep_probability(layer_idx, cfg.n_layer,
                                              pld_theta) if use_pld else None)
-            return block(x, mask=mask, deterministic=deterministic,
+            return block(x, mask=mask, segment_ids=segment_ids,
+                         positions=positions, deterministic=deterministic,
                          decode=decode, pld_keep=pld_keep)
 
         if cfg.remat:
@@ -880,9 +915,11 @@ class ScannedBlocks(nn.Module):
                                   policy=_remat_policy(cfg.remat_policy))
 
         def body(block, carry, layer_idx):
-            x, mask = carry
-            x, l_aux = call_block(block, x, mask, layer_idx)
-            return (x, mask), l_aux
+            # None entries are valid (empty) pytree leaves in the carry
+            x, mask, segment_ids, positions = carry
+            x, l_aux = call_block(block, x, mask, segment_ids, positions,
+                                  layer_idx)
+            return (x, mask, segment_ids, positions), l_aux
 
         block_cls = _maybe_quantized_block(Block, cfg)
         if cfg.param_offload:
@@ -905,8 +942,9 @@ class ScannedBlocks(nn.Module):
             length=cfg.n_layer,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), l_aux = scanned(block_cls(cfg, name="block"), (x, mask),
-                                jnp.arange(cfg.n_layer))
+        (x, _, _, _), l_aux = scanned(
+            block_cls(cfg, name="block"),
+            (x, mask, segment_ids, positions), jnp.arange(cfg.n_layer))
         return x, jnp.sum(l_aux)
 
 
@@ -961,7 +999,8 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, attention_mask=None,
-                 deterministic=True, decode=False, pld_theta=None):
+                 segment_ids=None, positions=None, deterministic=True,
+                 decode=False, pld_theta=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = VocabEmbed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
@@ -988,22 +1027,28 @@ class GPT(nn.Module):
                     pos = position.value[:, None] + jnp.arange(T)[None, :]
                     position.value = position.value + T
             else:
-                pos = jnp.arange(T)[None, :]
+                # packed batches reset positions at each document start so
+                # every document sees the embeddings it would alone
+                pos = (positions if positions is not None
+                       else jnp.arange(T)[None, :])
             x = x + wpe(pos)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         if cfg.scan_layers:
             x, l_aux = ScannedBlocks(cfg, name="h")(
-                x, mask=attention_mask, deterministic=deterministic,
+                x, mask=attention_mask, segment_ids=segment_ids,
+                positions=positions, deterministic=deterministic,
                 decode=decode, pld_theta=pld_theta)
         else:
             l_aux = jnp.float32(0.0)
             use_pld = (cfg.stochastic_mode and pld_theta is not None
                        and not deterministic)
 
-            def call_block(block, x, mask, pld_keep):
+            def call_block(block, x, mask, segment_ids, positions, pld_keep):
                 # closure keeps deterministic/decode static under remat
-                return block(x, mask=mask, deterministic=deterministic,
+                return block(x, mask=mask, segment_ids=segment_ids,
+                             positions=positions,
+                             deterministic=deterministic,
                              decode=decode, pld_keep=pld_keep)
 
             if cfg.remat:
@@ -1014,7 +1059,8 @@ class GPT(nn.Module):
                 keep = (pld_keep_probability(i, cfg.n_layer, pld_theta)
                         if use_pld else None)
                 x, aux_i = call_block(loop_block_cls(cfg, name=f"h_{i}"), x,
-                                      attention_mask, keep)
+                                      attention_mask, segment_ids, positions,
+                                      keep)
                 l_aux = l_aux + aux_i
 
         x = _norm(cfg, "ln_f")(x)
@@ -1061,7 +1107,8 @@ class GPT(nn.Module):
             from deepspeed_tpu.ops.cross_entropy import (
                 fused_linear_cross_entropy)
 
-            targets, wts = _shifted_targets(labels, attention_mask)
+            targets, wts = _shifted_targets(labels, attention_mask,
+                                            segment_ids)
             flat = x.astype(cfg.dtype).reshape(-1, cfg.n_embd)
             # bool first: True is an int and would read as chunk=1
             chunk = (fused if isinstance(fused, int)
@@ -1076,7 +1123,8 @@ class GPT(nn.Module):
                 x.astype(cfg.dtype), head_w, head_dims)
             if head_b is not None:
                 logits = logits + head_b.astype(logits.dtype)
-            loss = cross_entropy_loss(logits, labels, attention_mask)
+            loss = cross_entropy_loss(logits, labels, attention_mask,
+                                      segment_ids)
         if cfg.is_moe:
             # load-balance aux loss, averaged over layers (reference adds the
             # per-MoE-layer l_aux into the training loss with a coefficient)
@@ -1084,10 +1132,17 @@ class GPT(nn.Module):
         return loss
 
 
-def _shifted_targets(labels, mask=None):
+def _shifted_targets(labels, mask=None, segment_ids=None):
     """Next-token targets + f32 weights: target for position i is
     labels[i+1]; the last position gets a dummy target with zero weight —
-    all tensors stay tile-aligned (no [b, t-1] slicing)."""
+    all tensors stay tile-aligned (no [b, t-1] slicing).
+
+    With ``segment_ids`` (packed batches, deepspeed_tpu/data/), a position
+    whose next token belongs to a DIFFERENT segment — a document's last
+    token predicting the next document's first, or any pad (segment 0)
+    position — is zero-weighted too. This is the third leg of the packing
+    exactness condition (docs/data.md): the weighted mean then equals the
+    token-count-weighted mean of the per-document losses."""
     b, t = labels.shape
     targets = jnp.concatenate(
         [labels[:, 1:], jnp.zeros((b, 1), labels.dtype)], axis=1)
@@ -1099,16 +1154,22 @@ def _shifted_targets(labels, mask=None):
         w = jnp.concatenate(
             [jnp.ones((b, t - 1), jnp.float32),
              jnp.zeros((b, 1), jnp.float32)], axis=1)
+    if segment_ids is not None:
+        seg_next = jnp.concatenate(
+            [segment_ids[:, 1:], jnp.zeros((b, 1), segment_ids.dtype)],
+            axis=1)
+        w = w * ((segment_ids == seg_next)
+                 & (segment_ids != 0)).astype(jnp.float32)
     return targets, w
 
 
-def cross_entropy_loss(logits, labels, mask=None):
+def cross_entropy_loss(logits, labels, mask=None, segment_ids=None):
     """Mean next-token cross entropy with shift (f32 reductions fused over
     compute-dtype logits; see ops/cross_entropy.py)."""
     from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
 
     b, t = labels.shape
-    targets, w = _shifted_targets(labels, mask)
+    targets, w = _shifted_targets(labels, mask, segment_ids)
     flat = logits.reshape(b * t, logits.shape[-1])
     return softmax_cross_entropy(flat, targets.reshape(b * t),
                                  w.reshape(b * t))

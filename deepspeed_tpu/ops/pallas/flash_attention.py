@@ -13,6 +13,23 @@ logsumexp — O(T) memory like the forward.
 
 On non-TPU backends the kernels run in Pallas interpreter mode, so the CPU
 test mesh exercises the exact same code path.
+
+Packed sequences (``segment_ids``): when the data pipeline bin-packs
+several documents into one row (``deepspeed_tpu/data/packing.py``),
+attention must be restricted to *causal AND same-segment* for the packed
+loss to be exact vs running each document alone (docs/data.md). The
+segment mask rides into the kernels in two pre-broadcast layouts chosen
+to match TPU tiling with no in-kernel transpose:
+
+* ``seg_r [bh, t, LSE_LANES]`` — row layout, sliced like q/lse blocks to
+  give the query-side segment id column;
+* ``seg_c [bh, LSE_LANES, t]`` — column layout, sliced along the lane
+  axis to give the key-side segment id row.
+
+Masking uses the same finite ``NEG_INF`` as the causal path: a masked
+score contributes ``exp(-1e30) == 0.0`` exactly to both softmax and its
+gradient, so cross-segment leakage is zero, and pad rows (segment 0)
+still see their own diagonal so no row is ever fully masked.
 """
 
 import functools
@@ -33,8 +50,12 @@ from deepspeed_tpu.ops.pallas.common import (
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
+                has_seg=False):
+    if has_seg:
+        sq_ref, sk_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     bq, d = q_ref.shape
     t = k_ref.shape[0]
     nk = t // block_k
@@ -48,6 +69,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     acc = jnp.zeros((bq, d), jnp.float32)
 
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    if has_seg:
+        q_seg = sq_ref[...][:, :1]  # [bq, 1]
+        k_seg_row = sk_ref[...]     # [LSE_LANES, t]
 
     def body(j, carry):
         m, l, acc = carry
@@ -61,6 +85,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_seg:
+            k_seg = jax.lax.dynamic_slice(
+                k_seg_row, (0, j * block_k), (1, block_k))
+            s = jnp.where(q_seg == k_seg, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -83,7 +111,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), (bq, LSE_LANES))
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, seg, scale, causal, block_q, block_k):
     b, t, h, d = q.shape
     bh = b * h
     qf = q.transpose(0, 2, 1, 3).reshape(bh, t, d)
@@ -91,15 +119,25 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
     vf = v.transpose(0, 2, 1, 3).reshape(bh, t, d)
     nq = t // block_q
 
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if seg is not None:
+        seg_r, seg_c = seg
+        in_specs += [
+            pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, LSE_LANES, t), lambda i, j: (i, 0, 0)),
+        ]
+        operands += [seg_r, seg_c]
+
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, has_seg=seg is not None),
         grid=(bh, nq),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
@@ -109,15 +147,19 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((bh, t, LSE_LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qf, kf, vf)
+    )(*operands)
     return o, lse
 
 
 # ---------------------------------------------------------------------------
 # backward (recompute with saved lse)
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, block_k, has_seg=False):
+    if has_seg:
+        sq_ref, sk_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     bq, d = q_ref.shape
     t = k_ref.shape[0]
     nk = t // block_k
@@ -129,6 +171,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     delta = delta_ref[...][:, :1]
     dq = jnp.zeros((bq, d), jnp.float32)
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    if has_seg:
+        q_seg = sq_ref[...][:, :1]  # [bq, 1]
+        k_seg_row = sk_ref[...]     # [LSE_LANES, t]
 
     def body(j, dq):
         k_blk = k_ref[pl.ds(j * block_k, block_k), :]
@@ -140,6 +185,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_seg:
+            k_seg = jax.lax.dynamic_slice(
+                k_seg_row, (0, j * block_k), (1, block_k))
+            s = jnp.where(q_seg == k_seg, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -155,8 +204,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, block_q, has_seg=False):
+    if has_seg:
+        sr_ref, sc_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     bk, d = k_ref.shape
     t = q_ref.shape[0]
     nq = t // block_q
@@ -167,6 +220,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk = jnp.zeros((bk, d), jnp.float32)
     dv = jnp.zeros((bk, d), jnp.float32)
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    if has_seg:
+        k_seg = sc_ref[...][:1, :]  # [1, bk]
 
     def body(i, carry):
         dk, dv = carry
@@ -182,6 +237,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = j * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_seg:
+            q_seg_blk = sr_ref[pl.ds(j * block_q, block_q), :1]  # [block_q, 1]
+            s = jnp.where(q_seg_blk == k_seg, s, NEG_INF)
         p = jnp.exp(s - lse_blk)
         pb = p.astype(do_blk.dtype)
         dv = dv + jax.lax.dot_general(
@@ -206,9 +264,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v, o, lse = res
-    do = g
+def _bwd_impl(scale, causal, block_q, block_k, q, k, v, o, lse, do,
+              seg=None):
     b, t, h, d = q.shape
     bh = b * h
 
@@ -221,35 +278,54 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LSE_LANES,))
 
     nq, nk = t // block_q, t // block_k
+    dq_in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+    ]
+    dq_operands = [qf, kf, vf, dof, lse, delta]
+    dkv_in_specs = [
+        pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
+    ]
+    dkv_operands = [qf, kf, vf, dof, lse, delta]
+    if seg is not None:
+        seg_r, seg_c = seg
+        dq_in_specs += [
+            pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, LSE_LANES, t), lambda i, j: (i, 0, 0)),
+        ]
+        dq_operands += [seg_r, seg_c]
+        # dkv slices the row layout by q block in-kernel and takes its own
+        # k block from the column layout
+        dkv_in_specs += [
+            pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, LSE_LANES, block_k), lambda i, j: (i, 0, j)),
+        ]
+        dkv_operands += [seg_r, seg_c]
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, has_seg=seg is not None),
         grid=(bh, nq),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q, LSE_LANES), lambda i, j: (i, j, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dq_operands)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, has_seg=seg is not None),
         grid=(bh, nk),
-        in_specs=[
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, LSE_LANES), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
@@ -259,7 +335,7 @@ def _bwd(scale, causal, block_q, block_k, res, g):
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         ],
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dkv_operands)
 
     def unflat(x):
         return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
@@ -267,19 +343,24 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     return unflat(dq), unflat(dk), unflat(dv)
 
 
+def _bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    return _bwd_impl(scale, causal, block_q, block_k, q, k, v, o, lse, g)
+
+
 # ---------------------------------------------------------------------------
 # public op
 # ---------------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    o, _ = _fwd(q, k, v, None, scale, causal, block_q, block_k)
     return o
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     from jax.ad_checkpoint import checkpoint_name
 
-    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    o, lse = _fwd(q, k, v, None, scale, causal, block_q, block_k)
     # under remat, tagging the kernel outputs lets a names-aware policy keep
     # them (o: 2 bytes/elem, lse: 1/head_dim of that) instead of re-running
     # the whole forward kernel to regenerate residuals in the backward pass
@@ -291,13 +372,47 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_seg(q, k, v, seg_r, seg_c, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, (seg_r, seg_c), scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_seg_fwd(q, k, v, seg_r, seg_c, scale, causal, block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
+
+    o, lse = _fwd(q, k, v, (seg_r, seg_c), scale, causal, block_q, block_k)
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return o, (q, k, v, seg_r, seg_c, o, lse)
+
+
+def _flash_seg_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, seg_r, seg_c, o, lse = res
+    dq, dk, dv = _bwd_impl(scale, causal, block_q, block_k, q, k, v, o, lse,
+                           g, seg=(seg_r, seg_c))
+    # integer operands take symbolic-zero (float0) cotangents
+    dseg_r = np.zeros(seg_r.shape, jax.dtypes.float0)
+    dseg_c = np.zeros(seg_c.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg_r, dseg_c
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
-                    block_q: int = None, block_k: int = None,
-                    autotune: bool = None):
+                    segment_ids=None, block_q: int = None,
+                    block_k: int = None, autotune: bool = None):
     """Blockwise attention over ``[batch, seq, heads, head_dim]`` inputs.
 
     Memory is O(seq) per program instead of O(seq^2); the [T, T] score matrix
     only ever exists one [block_q, block_k] tile at a time in VMEM.
+
+    ``segment_ids`` (``[batch, seq]`` int, 0 = padding) restricts attention
+    to *causal AND same-segment* for packed-sequence batches
+    (``deepspeed_tpu/data/``): position i attends j iff ``j <= i`` and
+    ``seg[i] == seg[j]``, which makes the packed forward/backward exact vs
+    per-document unpacked attention (docs/data.md).
 
     ``block_q``/``block_k`` default to the shape-tuned resolution in
     ``ops/pallas/autotune.py`` (disk cache -> pretuned table -> optional
@@ -316,5 +431,18 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
         block_k = tuned_k if block_k is None else block_k
     block_q = _block(t, block_q)
     block_k = _block(t, block_k)
-    of = _flash(q, k, v, float(scale), bool(causal), block_q, block_k)
+    if segment_ids is None:
+        of = _flash(q, k, v, float(scale), bool(causal), block_q, block_k)
+    else:
+        if segment_ids.shape != (b, t):
+            raise ValueError(
+                f"segment_ids must be [batch, seq] = {(b, t)}, got "
+                f"{segment_ids.shape}")
+        # head-replicated [b*h, t] matches the kernels' batch-major
+        # flattening (program i = b_idx * h + h_idx)
+        segf = jnp.repeat(segment_ids.astype(jnp.int32), h, axis=0)
+        seg_r = jnp.broadcast_to(segf[:, :, None], (b * h, t, LSE_LANES))
+        seg_c = jnp.broadcast_to(segf[:, None, :], (b * h, LSE_LANES, t))
+        of = _flash_seg(q, k, v, seg_r, seg_c, float(scale), bool(causal),
+                        block_q, block_k)
     return of.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -135,6 +135,15 @@ class StepProfiler:
         self._phase_acc: Dict[str, float] = {}
         self._jax_trace_on = False
         self._pid = 0
+        # subsystem gauges merged into perf_counters (the engine feeds
+        # the data-pipeline prefetch queue-depth/starvation stats here)
+        self.aux_counters: Dict[str, float] = {}
+
+    def set_aux_counters(self, counters: Dict[str, float]) -> None:
+        """Attach external gauges to the ``Perf/*`` export. Last write
+        wins per key; cheap enough to call every step."""
+        self.aux_counters.update(
+            {str(k): float(v) for k, v in counters.items()})
 
     # -- gating ------------------------------------------------------------
     def active_for(self, step: int) -> bool:
@@ -328,6 +337,7 @@ class StepProfiler:
         }
         for k, v in s["phases_ms"].items():
             out[f"phase_{k}_ms"] = v
+        out.update(self.aux_counters)
         return out
 
     # -- trace export ------------------------------------------------------

@@ -240,6 +240,46 @@ class StepProfilerConfig(ConfigModel):
 
 
 @dataclass
+class DataPipelineConfig(ConfigModel):
+    """Input data pipeline (deepspeed_tpu/data/, docs/data.md): swaps the
+    engine's synchronous ``DeepSpeedDataLoader`` path for deterministic
+    sharded streaming + sequence packing + background device prefetch.
+    Disabled (the default) the input path is byte-identical to the
+    historical loop — ``deepspeed_io`` builds the same loader as ever."""
+
+    enabled: bool = False
+    # bin-pack variable-length documents into [B, seq_length] with
+    # segment_ids/positions; False collates one sample per row instead
+    pack_sequences: bool = True
+    seq_length: int = 1024
+    pad_token_id: int = 0
+    shuffle: bool = True
+    seed: int = 0
+    # "process": shard the sample stream by jax process (DP rank);
+    # "none": every process sees the full stream
+    shard: str = "process"
+    # background worker that runs the engine's sharded device_put so h2d
+    # of batch N+1 overlaps compute of batch N
+    prefetch: bool = True
+    prefetch_depth: int = 2
+    # pack to the curriculum scheduler's quantized difficulty seq-len
+    # (bounded compiled-shape count; see docs/data.md)
+    curriculum_pack: bool = True
+
+    def __post_init__validate__(self):
+        if self.seq_length < 2:
+            raise DeepSpeedConfigError(
+                "data_pipeline.seq_length must be >= 2")
+        if self.prefetch_depth < 1:
+            raise DeepSpeedConfigError(
+                "data_pipeline.prefetch_depth must be >= 1")
+        if self.shard not in ("process", "none"):
+            raise DeepSpeedConfigError(
+                f"data_pipeline.shard must be 'process' or 'none', got "
+                f"{self.shard!r}")
+
+
+@dataclass
 class CurriculumConfig(ConfigModel):
     enabled: bool = False
     curriculum_type: str = "seqlen"
@@ -538,6 +578,8 @@ class DeepSpeedConfig:
         self.comms_logger = CommsLoggerConfig.from_dict(pd.get(C.COMMS_LOGGER, {}))
         self.step_profiler = StepProfilerConfig.from_dict(
             pd.get(C.STEP_PROFILER, {}))
+        self.data_pipeline = DataPipelineConfig.from_dict(
+            pd.get(C.DATA_PIPELINE, {}))
         self.curriculum_learning = CurriculumConfig.from_dict(
             pd.get(C.CURRICULUM_LEARNING, {})
         )

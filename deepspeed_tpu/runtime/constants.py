@@ -142,7 +142,10 @@ DIVERGENCE_EXIT_CODE_DEFAULT = 13
 SENTINEL_HANG_EXIT_CODE_DEFAULT = 14
 
 DATALOADER_DROP_LAST = "dataloader_drop_last"
-DATALOADER_DROP_LAST_DEFAULT = False
+# True matches what deepspeed_io has always DONE (a hard-coded drop_last
+# that ignored this knob); the knob is now honored, and False engages the
+# pad-and-mask tail batch so the compiled shape never changes mid-epoch
+DATALOADER_DROP_LAST_DEFAULT = True
 
 #############################################
 # Pipeline block (reference pipe config)
@@ -171,6 +174,9 @@ MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
 COMMS_LOGGER = "comms_logger"
 STEP_PROFILER = "step_profiler"
+# Input data pipeline (deepspeed_tpu/data/, docs/data.md): deterministic
+# sharded streaming + sequence packing + background device prefetch
+DATA_PIPELINE = "data_pipeline"
 AIO = "aio"
 NEBULA = "nebula"
 QUANTIZE_TRAINING = "quantize_training"

@@ -23,6 +23,38 @@ def default_collate(samples):
     return np.stack(samples)
 
 
+def _pad_to_batch_size(batch, batch_size: int):
+    """Pad a (possibly ragged tail) batch to ``batch_size`` rows.
+
+    Dict batches get zero rows plus an ``attention_mask`` that zeroes the
+    pad rows out of attention AND the loss (the model's weighting path);
+    the mask is emitted for full batches too so the pytree structure —
+    and with it the compiled program — is identical for every batch.
+    Non-dict batches just get zero rows (no mask channel to thread)."""
+    if isinstance(batch, dict):
+        n = next(iter(batch.values())).shape[0]
+        pad = batch_size - n
+        out = {}
+        for k, v in batch.items():
+            if pad:
+                zeros = np.zeros((pad,) + v.shape[1:], v.dtype)
+                out[k] = np.concatenate([v, zeros], axis=0)
+            else:
+                out[k] = v
+        if "attention_mask" not in out and "input_ids" in out:
+            mask = np.zeros(out["input_ids"].shape[:2], np.int32)
+            mask[:n] = 1
+            out["attention_mask"] = mask
+        return out
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_pad_to_batch_size(v, batch_size) for v in batch)
+    pad = batch_size - batch.shape[0]
+    if not pad:
+        return batch
+    zeros = np.zeros((pad,) + batch.shape[1:], batch.dtype)
+    return np.concatenate([batch, zeros], axis=0)
+
+
 class DeepSpeedDataLoader:
     """Iterates a map-style dataset in global batches.
 
@@ -47,6 +79,12 @@ class DeepSpeedDataLoader:
         self._base_seed = seed
         self.drop_last = drop_last
         self.collate_fn = collate_fn or default_collate
+        # drop_last=False with a ragged tail: the tail is PADDED to the
+        # full global batch and masked via attention_mask, so the engine
+        # compiles exactly one batch shape instead of one per epoch tail.
+        # The mask key must then exist on EVERY batch (a tail-only key
+        # would change the pytree structure and force a retrace anyway).
+        self._pad_tail = (not drop_last) and (len(dataset) % batch_size != 0)
         self.epoch = 0
         # bumped whenever (seed, epoch) changes out-of-band (reseed or
         # load_state_dict): RepeatingLoader watches it to restart its
@@ -95,7 +133,10 @@ class DeepSpeedDataLoader:
         for b in range(self.num_batches):
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             samples = [self.dataset[int(i)] for i in idx]
-            yield self.collate_fn(samples)
+            batch = self.collate_fn(samples)
+            if self._pad_tail:
+                batch = _pad_to_batch_size(batch, self.batch_size)
+            yield batch
 
 
 class RepeatingLoader:

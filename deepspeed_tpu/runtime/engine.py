@@ -1313,22 +1313,64 @@ class DeepSpeedEngine:
     # data
     # ------------------------------------------------------------------
     def deepspeed_io(self, dataset, collate_fn=None, shuffle=True):
-        """reference engine.py:1539 deepspeed_io -> DeepSpeedDataLoader."""
+        """reference engine.py:1539 deepspeed_io -> DeepSpeedDataLoader,
+        or the packed streaming pipeline (deepspeed_tpu/data/, docs/data.md)
+        when the ``data_pipeline`` block is enabled."""
         global_micro = (
             self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
         )
-        loader = DeepSpeedDataLoader(
-            dataset,
-            batch_size=global_micro,
-            shuffle=shuffle,
-            drop_last=self._config.dataloader_drop_last or True,
-            collate_fn=collate_fn,
-        )
+        dp_cfg = self._config.data_pipeline
+        if dp_cfg.enabled:
+            loader = self._build_data_pipeline(dataset, dp_cfg, global_micro,
+                                               shuffle)
+        else:
+            loader = DeepSpeedDataLoader(
+                dataset,
+                batch_size=global_micro,
+                shuffle=shuffle,
+                drop_last=self._config.dataloader_drop_last,
+                collate_fn=collate_fn,
+            )
         # the engine keeps the training loader: checkpoints carry its
         # (epoch, seed) state, and the sentinel reseeds it on rollback so
         # re-entry doesn't replay the exact batch sequence that diverged
         self.training_dataloader = loader
         return loader
+
+    def _build_data_pipeline(self, dataset, dp_cfg, global_micro, shuffle):
+        from deepspeed_tpu.data import DevicePrefetcher, PackedDataPipeline
+
+        shard_rank, num_shards = 0, 1
+        if dp_cfg.shard == "process":
+            shard_rank, num_shards = jax.process_index(), jax.process_count()
+        seqlen_fn = None
+        if dp_cfg.curriculum_pack and self.curriculum_scheduler is not None:
+            sched = self.curriculum_scheduler
+            # pack to the scheduler's quantized difficulty; compiled-shape
+            # count stays bounded by the schedule's distinct values. Under
+            # prefetch the packer can lag the schedule by queue depth —
+            # the consume-time truncation in _apply_curriculum covers any
+            # monotone schedule (docs/data.md).
+            seqlen_fn = lambda: sched.current_difficulty  # noqa: E731
+        pipeline = PackedDataPipeline(
+            dataset,
+            batch_size=global_micro,
+            seq_length=dp_cfg.seq_length,
+            pack_sequences=dp_cfg.pack_sequences,
+            pad_token_id=dp_cfg.pad_token_id,
+            shuffle=shuffle and dp_cfg.shuffle,
+            seed=dp_cfg.seed,
+            shard_rank=shard_rank,
+            num_shards=num_shards,
+            seqlen_fn=seqlen_fn,
+        )
+        if not dp_cfg.prefetch:
+            return pipeline
+        # the worker thread runs the engine's sharded device_put, so h2d
+        # of batch N+1 overlaps compute of batch N; _put_batch passes
+        # already-placed arrays through untouched at consume time
+        return DevicePrefetcher(pipeline, put_fn=self._put_batch,
+                                depth=dp_cfg.prefetch_depth)
 
     def _put_batch(self, batch: Dict[str, Any]):
         sharding = self.topology.batch_sharding()
@@ -1348,8 +1390,14 @@ class DeepSpeedEngine:
                 # shard the sequence dim over sp (context parallelism)
                 spec = list(sharding.spec) + [None] * (x.ndim - len(sharding.spec))
                 spec[1] = "sp"
-                return jax.device_put(x, self.topology.sharding(*spec))
-            return jax.device_put(x, sharding)
+                target = self.topology.sharding(*spec)
+            else:
+                target = sharding
+            # already placed (the prefetch worker ran this device_put in
+            # the background): h2d at consume time is a no-op
+            if isinstance(x, jax.Array) and x.sharding == target:
+                return x
+            return jax.device_put(x, target)
 
         device_batch = jax.tree.map(put, batch)
         self._last_batch_aval = jax.tree.map(
@@ -1372,6 +1420,11 @@ class DeepSpeedEngine:
 
     def _prof_end_step(self):
         if self.step_profiler is not None:
+            # prefetch queue-depth/starvation gauges ride the Perf/*
+            # counter export (docs/observability.md)
+            loader = self.training_dataloader
+            if loader is not None and hasattr(loader, "counters"):
+                self.step_profiler.set_aux_counters(loader.counters())
             # counters passed as a callable: only materialized if this
             # end_step closes the window and exports
             self.step_profiler.end_step(
