@@ -16,6 +16,13 @@
 #                breakdown sums to step wall time, analytic MFU from the
 #                compiled step, and a perfetto-loadable trace
 #                (docs/observability.md)
+#   make blackbox crash-forensics gate: injected NaN divergence must
+#                leave a crc-valid flight-recorder blackbox (>=32 step
+#                records with phases/loss/comm + compiled memory) and a
+#                sweepable run-level crash report (docs/observability.md)
+#   make memreport  analytic HBM report for the 1.3B seq-1024 train step
+#                from avals-only AOT compile (docs/performance.md
+#                "The 1.3B memory ceiling")
 #   make serve-bench  continuous-batching vs sequential serving latency
 #                (TTFT / per-token / aggregate tok/s, CPU backend,
 #                commits benchmarks/inference/serving_bench_results.json)
@@ -33,8 +40,8 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/ops deepspeed_tpu/utils/timer.py \
              deepspeed_tpu/inference/engine.py
 
-.PHONY: quick test smoke chaos profile check hooks hot-changed serve-bench \
-        data-bench
+.PHONY: quick test smoke chaos profile blackbox memreport check hooks \
+        hot-changed serve-bench data-bench
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
 # process-group units, with tests marked `slow` (pyproject marker) opted
@@ -47,7 +54,7 @@ quick:
 	  tests/unit/test_grad_exchange_modes.py \
 	  tests/unit/test_flash_autotune.py tests/unit/test_procgroup.py \
 	  tests/unit/test_launcher.py tests/unit/test_serving.py \
-	  tests/unit/test_data_pipeline.py \
+	  tests/unit/test_data_pipeline.py tests/unit/test_telemetry.py \
 	  -q -x -m "not slow"
 
 test:
@@ -61,6 +68,13 @@ chaos:
 
 profile:
 	$(PY) benchmarks/profile_step.py
+
+blackbox:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/blackbox_check.py
+
+memreport:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/memory_report.py \
+	  --out benchmarks/memory_report_1p3b.json
 
 # continuous batching vs sequential generate: TTFT / per-token latency /
 # aggregate tokens/sec over >=16 concurrent streaming sequences at window

@@ -56,7 +56,7 @@ def analytic_step_metrics(engine, dt: float, peak: float = None) -> dict:
     if peak is None:
         peak, src = peak_tflops()
     tflops = cost["flops"] / dt / 1e12
-    return {
+    out = {
         "analytic_flops_per_step": cost["flops"],
         "analytic_tflops": round(tflops, 2),
         "analytic_mfu": round(tflops / peak, 4) if peak else 0.0,
@@ -64,6 +64,17 @@ def analytic_step_metrics(engine, dt: float, peak: float = None) -> dict:
         "analytic_peak_source": src,
         "hbm_gb_per_s": round(cost.get("bytes_accessed", 0.0) / dt / 1e9, 1),
     }
+    # Compiled-step memory_analysis() (telemetry/memory.py): the static
+    # HBM budget XLA committed to — argument/output/temp/alias breakdown
+    # plus the peak working set. Same best-effort contract as the cost
+    # model: absent on backends without memory analysis.
+    try:
+        mem = engine.compiled_step_memory()
+    except Exception:
+        mem = None
+    if mem:
+        out.update({f"analytic_mem_{k}": v for k, v in mem.items()})
+    return out
 
 
 def backend_preflight(max_tries: int = 2, backoff_s: float = 10.0,

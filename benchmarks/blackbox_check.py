@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Crash-forensics end-to-end check (``make blackbox``).
+
+Trains a tiny CPU GPT with the sentinel armed and a telemetry dump dir
+set, injects a persistent NaN via ``utils/fault_injection.py``
+``nan_at_step``, and asserts the whole evidence chain (ISSUE 10
+acceptance):
+
+1. the engine raises ``DivergenceError`` (exit-13 semantics) and the
+   flight recorder leaves an atomic ``blackbox-rank0.json``,
+2. the dump parses, its crc32 stamp verifies, and it holds >= 32 step
+   records each carrying phase timings, loss, grad-norm and ``Comm/*``
+   wire counters, plus the compiled-step ``memory_analysis()`` breakdown
+   in the static section,
+3. the divergence shows up in the event ring (``sentinel.diverged``,
+   severity fatal, with the poisoned step's non-finite loss recorded),
+4. ``sweep_blackbox_dumps`` merges the per-rank dump into a parseable
+   run-level ``crash-report.json`` naming rank 0 as first-fatal.
+
+Prints one summary JSON line; exits nonzero on any failed check.
+"""
+
+import json
+import math
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "JAX_PLATFORMS" not in os.environ:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader  # noqa: E402
+from deepspeed_tpu.runtime.sentinel import DivergenceError  # noqa: E402
+from deepspeed_tpu.telemetry import (  # noqa: E402
+    load_blackbox,
+    sweep_blackbox_dumps,
+)
+from deepspeed_tpu.utils import fault_injection as fi  # noqa: E402
+from tests.unit.simple_model import SimpleModel, random_dataset  # noqa: E402
+
+MICRO = 4
+HEALTHY_STEPS = 36  # ring must hold >= 32 full records when the NaN lands
+MIN_RING_STEPS = 32
+
+
+def run(tdir: str):
+    # the float-input regression fixture: nan_at_step poisons float
+    # batch leaves, which a token-id (int) batch does not have
+    ds = {
+        "train_micro_batch_size_per_gpu": MICRO,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 10 ** 9,
+        "sentinel": {"enabled": True, "skip_budget": 1,
+                     "rollback_budget": 0},
+        "telemetry": {"dump_dir": tdir},
+    }
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=ds,
+        training_data=random_dataset(64))
+    it = iter(RepeatingLoader(loader))
+    try:
+        for _ in range(HEALTHY_STEPS):
+            engine.train_batch(it)
+        diverged = None
+        with fi.nan_at_step(engine, step=HEALTHY_STEPS, times=None) as inj:
+            try:
+                for _ in range(10):
+                    engine.train_batch(it)
+            except DivergenceError as e:
+                diverged = e
+        return engine, diverged, inj.injected
+    finally:
+        if engine._telemetry_uninstall is not None:
+            engine._telemetry_uninstall()
+
+
+def check(tdir: str, diverged, injected) -> list:
+    failures = []
+    if diverged is None:
+        failures.append("injected NaN did not raise DivergenceError")
+        return failures
+    if diverged.exit_code != 13:
+        failures.append(f"divergence exit code {diverged.exit_code} != 13")
+    if not injected:
+        failures.append("fault injector never fired")
+
+    path = os.path.join(tdir, "blackbox-rank0.json")
+    payload, status = load_blackbox(path)
+    if payload is None:
+        return failures + [f"blackbox unreadable: {status}"]
+    if status != "ok":
+        failures.append(f"blackbox status {status} (crc/schema)")
+    if payload.get("reason") != "divergence":
+        failures.append(f"reason {payload.get('reason')!r} != 'divergence'")
+    if payload.get("exit_code") != 13:
+        failures.append(f"dump exit_code {payload.get('exit_code')} != 13")
+
+    steps = payload.get("steps") or []
+    if len(steps) < MIN_RING_STEPS:
+        failures.append(f"only {len(steps)} step records, "
+                        f"wanted >= {MIN_RING_STEPS}")
+    for field in ("phases_s", "loss", "grad_norm", "comm"):
+        missing = sum(1 for s in steps if field not in s)
+        if missing:
+            failures.append(f"{missing}/{len(steps)} step records "
+                            f"missing {field!r}")
+    if steps and not math.isnan(steps[-1].get("loss", 0.0)):
+        failures.append("poisoned step's non-finite loss not in the ring")
+    if steps and not any(s.get("comm", {}).get("total_wire_bytes") is not None
+                         for s in steps):
+        failures.append("no Comm/* wire counters in step records")
+
+    mem = (payload.get("static") or {}).get("compiled_memory") or {}
+    if not mem.get("peak_working_set_bytes", 0) > 0:
+        failures.append("compiled memory_analysis() breakdown missing "
+                        "from the static section")
+
+    events = payload.get("events") or []
+    diverged_evs = [e for e in events if e.get("kind") == "sentinel.diverged"]
+    if not diverged_evs:
+        failures.append("no sentinel.diverged event in the ring")
+    elif diverged_evs[-1].get("severity") != "fatal":
+        failures.append("sentinel.diverged not marked fatal")
+    if not any(e.get("kind") == "sentinel.skip" for e in events):
+        failures.append("no sentinel.skip event before the divergence")
+
+    report = sweep_blackbox_dumps(tdir)
+    if report is None:
+        failures.append("sweep found no dumps")
+        return failures
+    if report.get("num_ranks") != 1 or report.get("first_fatal_rank") != "0":
+        failures.append(f"bad crash report rank summary: "
+                        f"num_ranks={report.get('num_ranks')} "
+                        f"first_fatal={report.get('first_fatal_rank')!r}")
+    with open(report["path"]) as f:
+        if json.load(f).get("schema") != "ds-tpu-crash-report/1":
+            failures.append("crash-report.json schema mismatch")
+    return failures
+
+
+def main() -> int:
+    tdir = tempfile.mkdtemp(prefix="ds_tpu_blackbox_")
+    _, diverged, injected = run(tdir)
+    failures = check(tdir, diverged, injected)
+    print(json.dumps({
+        "ok": not failures,
+        "failures": failures,
+        "telemetry_dir": tdir,
+        "blackbox": os.path.join(tdir, "blackbox-rank0.json"),
+        "crash_report": os.path.join(tdir, "crash-report.json"),
+    }, indent=2))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

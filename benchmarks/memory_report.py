@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Analytic HBM report for a training-step executable (``make memreport``).
+
+Answers ROADMAP item 3's memory question with XLA's own accounting
+instead of a hand-derived byte count: AOT-lower the full train step
+(fwd + bwd + Adam update) for a named GPT config with **avals only** —
+no parameter ever materializes, so the 1.3B report runs on a laptop
+CPU — compile it, and read ``memory_analysis()`` (argument / output /
+temp / donation-aliased bytes, peak working set). The committed artifact
+(``benchmarks/memory_report_1p3b.json``) backs the memory-ceiling note
+in docs/performance.md.
+
+Mirrors the benched pure-bf16 recipe (``gpt_pretrain.py``): bf16 params
+AND bf16 Adam moments, no fp32 masters, donated params/opt-state,
+scan_layers + full remat. Caveat recorded in the artifact: on the CPU
+backend ``use_flash_attention="auto"`` resolves to the dense-remat
+attention path, so layer temps OVERESTIMATE the flash-kernel step that
+actually runs on a v5e — the ceiling is conservative.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "JAX_PLATFORMS" not in os.environ:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from deepspeed_tpu.models.transformer_lm import (  # noqa: E402
+    GPT,
+    gpt2_config,
+    num_params,
+)
+from deepspeed_tpu.telemetry.memory import (  # noqa: E402
+    DEVICE_HBM_GIB,
+    compiled_memory_analysis,
+    format_bytes,
+)
+
+_GIB = 1024 ** 3
+
+
+def avals_of(tree):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
+def build_step(model, tx):
+    def train_step(params, opt_state, batch, rng):
+        def loss_fn(p):
+            return model.apply(p, batch["input_ids"],
+                               labels=batch["labels"],
+                               deterministic=False,
+                               rngs={"dropout": rng})
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def run(model_name: str, seq: int, micro: int) -> dict:
+    cfg = gpt2_config(model_name, n_positions=seq, dtype=jnp.bfloat16,
+                      param_dtype=jnp.bfloat16, scan_layers=True,
+                      remat=True, remat_policy="full",
+                      use_flash_attention="auto")
+    model = GPT(cfg)
+    ids = jax.ShapeDtypeStruct((micro, seq), jnp.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    # eval_shape: the 1.3B parameter tree exists only as avals
+    params = jax.eval_shape(model.init, rng, ids)
+    # pure-bf16 Adam: moments inherit the bf16 param dtype (no masters)
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(2e-4, b1=0.9, b2=0.95, weight_decay=0.1))
+    opt_state = jax.eval_shape(tx.init, params)
+
+    mem = compiled_memory_analysis(build_step(model, tx), params,
+                                   opt_state, batch, rng)
+
+    n = num_params(cfg)
+    state_bytes = {
+        # steady-state residency, from first principles for cross-check:
+        # bf16 params + 2 bf16 Adam moments = 6 bytes/param
+        "params_bytes": 2 * n,
+        "adam_moments_bytes": 4 * n,
+    }
+    report = {
+        "model": model_name,
+        "n_params": n,
+        "seq": seq,
+        "micro_batch": micro,
+        "recipe": "pure-bf16 (bf16 params + bf16 Adam moments, "
+                  "no fp32 masters), scan_layers, full remat, "
+                  "donated params/opt_state",
+        "backend": jax.default_backend(),
+        "caveats": [
+            "compiled on the CPU backend: use_flash_attention='auto' "
+            "resolves to dense-remat attention, so temp bytes "
+            "OVERESTIMATE the flash-kernel step that runs on a v5e",
+            "single device (dp=1): no collective buffers in the program",
+        ],
+        "compiled_memory": mem,
+        "first_principles": state_bytes,
+        "hbm_headroom": {},
+        "pretty": {k: format_bytes(v) for k, v in mem.items()
+                   if k.endswith("bytes")},
+    }
+    for kind, gib in DEVICE_HBM_GIB:
+        if kind in ("v5e", "v5p", "v4"):
+            cap = gib * _GIB
+            peak = mem["peak_working_set_bytes"]
+            report["hbm_headroom"][kind] = {
+                "hbm_gib": gib,
+                "peak_fraction": round(peak / cap, 3),
+                "headroom": format_bytes(max(0.0, cap - peak)),
+                "fits": peak < cap,
+            }
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="gpt2-1.3b")
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--micro", type=int, default=6,
+                    help="micro batch (6 = the benched v5e flash config)")
+    ap.add_argument("--out", default=None,
+                    help="write the report JSON here as well as stdout")
+    args = ap.parse_args()
+    report = run(args.model, args.seq, args.micro)
+    text = json.dumps(report, indent=2, default=str)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

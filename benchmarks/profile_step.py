@@ -117,6 +117,19 @@ def main() -> int:
                         f"{summary.get('analytic_mfu')!r}")
     if not summary.get("flops_per_step", 0.0) > 0.0:
         failures.append("no compiled-step FLOPs extracted")
+    # Memory accounting (ISSUE 10): the compiled step's memory_analysis()
+    # breakdown must ride in the same artifact. CPU supports the API, so
+    # a missing/zero peak here means the capture wiring broke.
+    mem = summary.get("memory")
+    if not mem:
+        failures.append("no compiled-step memory_analysis() in summary")
+    elif not mem.get("peak_working_set_bytes", 0.0) > 0.0:
+        failures.append(f"peak_working_set_bytes not positive: "
+                        f"{mem.get('peak_working_set_bytes')!r}")
+    elif not (mem.get("train_step_argument_bytes", 0.0) > 0.0
+              or mem.get("fwd_bwd_argument_bytes", 0.0) > 0.0):
+        failures.append("memory breakdown missing per-program detail "
+                        "(neither train_step_* nor fwd_bwd_* present)")
     failures += check_trace(trace_path)
 
     print(json.dumps({

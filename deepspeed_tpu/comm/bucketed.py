@@ -100,7 +100,15 @@ def plan_for_tree(tree: Any, bucket_mb: float, itemsize: int = 4
     """Bucket plan for a pytree of arrays / ShapeDtypeStructs."""
     sizes = [int(np.prod(leaf.shape)) if leaf.shape else 1
              for leaf in jax.tree.leaves(tree)]
-    return assign_buckets(sizes, int(bucket_mb * 1024 * 1024), itemsize)
+    plan = assign_buckets(sizes, int(bucket_mb * 1024 * 1024), itemsize)
+    # plans are built at trace/compile time, never per step — a plan
+    # change mid-run (retrace) is exactly what forensics wants to see
+    from deepspeed_tpu.telemetry.bus import KIND_BUCKET_PLAN, publish
+
+    publish(KIND_BUCKET_PLAN, num_buckets=plan.num_buckets,
+            num_leaves=len(sizes), bucket_mb=float(bucket_mb),
+            total_bytes=int(sum(sizes)) * int(itemsize))
+    return plan
 
 
 def _concat_bucket(leaves, idxs, dtype=None):

@@ -128,6 +128,16 @@ class DevicePrefetcher:
         self._depth_max = max(self._depth_max, depth)
         if depth == 0:
             self._starved_gets += 1
+            # the consumer is about to block on the producer: the feed-
+            # health signal the flight recorder keeps (stdlib-only import,
+            # swallows subscriber errors — never breaks the iterator)
+            from deepspeed_tpu.telemetry.bus import (
+                KIND_PREFETCH_STARVED,
+                publish,
+            )
+
+            publish(KIND_PREFETCH_STARVED, severity="warning",
+                    starved_gets=self._starved_gets, gets=self._gets)
         got = self._queue.get()
         if got is _END:
             self._thread = None

@@ -100,7 +100,8 @@ class DSElasticAgent:
                  ckpt_dir: Optional[str] = None,
                  divergence_exit_codes=(
                      ds_constants.DIVERGENCE_EXIT_CODE_DEFAULT,),
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 telemetry_dir: Optional[str] = None):
         self.cmd = list(cmd)
         self.ds_config = ds_config
         self.discover_world = discover_world or (
@@ -116,6 +117,10 @@ class DSElasticAgent:
         self.divergence_exit_codes = frozenset(
             int(c) for c in (divergence_exit_codes or ()))
         self.env = dict(env if env is not None else os.environ)
+        # telemetry rendezvous dir: exported to workers (their flight
+        # recorders dump blackboxes there) and swept into a run-level
+        # crash report after every failure (docs/observability.md)
+        self.telemetry_dir = telemetry_dir
         self.restart_count = 0
         self._failure_times: List[float] = []
         self._proc: Optional[subprocess.Popen] = None
@@ -126,6 +131,11 @@ class DSElasticAgent:
         env = dict(self.env)
         env["DS_TPU_NUM_PROCS"] = str(world)
         env["DS_TPU_ELASTIC_RESTART"] = str(self.restart_count)
+        if self.telemetry_dir:
+            from deepspeed_tpu.telemetry.crash_report import (
+                TELEMETRY_DIR_ENV)
+
+            env[TELEMETRY_DIR_ENV] = self.telemetry_dir
         if self.ckpt_dir:
             # advertise the newest MANIFEST-VALID tag: the worker's
             # load_checkpoint falls back to it when the 'latest' pointer
@@ -209,6 +219,7 @@ class DSElasticAgent:
                 return 1
             if rc == 0:
                 return 0
+            self._sweep_crash_report(rc)
             if rc in self.divergence_exit_codes:
                 logger.error(
                     f"worker exited with divergence code {rc}: training "
@@ -245,6 +256,36 @@ class DSElasticAgent:
             if delay > 0:
                 self._sleep(delay)
 
+    def _sweep_crash_report(self, rc: int) -> None:
+        """Merge the workers' blackbox dumps into ``crash-report.json``.
+
+        Called after every non-zero worker exit: even if the agent then
+        restarts, the report snapshots what the last incarnation left
+        behind (the next crash's dumps overwrite per-rank files, and the
+        sweep re-runs). Never raises — forensics must not change the
+        supervision outcome."""
+        if not self.telemetry_dir:
+            return
+        try:
+            from deepspeed_tpu.telemetry.crash_report import (
+                sweep_blackbox_dumps)
+
+            report = sweep_blackbox_dumps(self.telemetry_dir)
+        except Exception as e:  # pragma: no cover
+            logger.warning(f"blackbox sweep failed: {e}")
+            return
+        if report is None:
+            logger.info(
+                f"worker exited rc={rc} but left no blackbox dump under "
+                f"{self.telemetry_dir} (crash before telemetry armed, or "
+                f"dumps disabled)")
+            return
+        logger.error(
+            f"crash report: {report['path']} — {report['num_ranks']} "
+            f"rank(s), reasons={report['reasons']}, last step "
+            f"{report['last_step_min']}..{report['last_step_max']}, "
+            f"first fatal rank {report['first_fatal_rank']}")
+
 
 def main(argv=None) -> int:
     """CLI: ``python -m deepspeed_tpu.elasticity.elastic_agent [--config
@@ -268,6 +309,10 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt_dir", default=None,
                    help="checkpoint root; the newest manifest-valid tag "
                         "is exported to workers as DS_TPU_LAST_VALID_TAG")
+    p.add_argument("--telemetry_dir", default=None,
+                   help="flight-recorder dir exported to workers as "
+                        "DS_TPU_TELEMETRY_DIR; per-rank blackbox dumps "
+                        "are swept into crash-report.json on failure")
     p.add_argument("--divergence_exit_code", type=int, action="append",
                    default=None,
                    help="worker exit code meaning 'training diverged' — "
@@ -292,7 +337,8 @@ def main(argv=None) -> int:
         ckpt_dir=args.ckpt_dir,
         divergence_exit_codes=(
             args.divergence_exit_code if args.divergence_exit_code
-            else (ds_constants.DIVERGENCE_EXIT_CODE_DEFAULT,)))
+            else (ds_constants.DIVERGENCE_EXIT_CODE_DEFAULT,)),
+        telemetry_dir=args.telemetry_dir)
     return agent.run()
 
 

@@ -47,6 +47,37 @@ def feature_table():
                  "built" if native.available() else "not built "
                  "(python -m deepspeed_tpu.ops.native to build)",
                  GREEN_OK if native.available() else RED_NO))
+
+    # Memory accounting (docs/observability.md, "Memory accounting"):
+    # live Mem/* watermarks need device.memory_stats(); HBM headroom %
+    # needs a device_kind capacity-table entry. Report both per backend.
+    from deepspeed_tpu.profiling.step_profiler import peak_tflops
+    from deepspeed_tpu.telemetry.memory import (format_bytes, hbm_bytes,
+                                                live_memory_stats)
+
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        devs = []
+    if devs:
+        n_live = sum(1 for d in devs if live_memory_stats(d) is not None)
+        rows.append(("device memory_stats()",
+                     f"{n_live}/{len(devs)} devices report live stats",
+                     GREEN_OK if n_live else RED_NO))
+        cap, cap_src = hbm_bytes(devs[0])
+        rows.append(("HBM capacity table",
+                     f"{format_bytes(cap)} ({cap_src})" if cap is not None
+                     else cap_src,
+                     GREEN_OK if cap is not None else RED_NO))
+        peak, peak_src = peak_tflops(devs[0])
+        rows.append(("peak bf16 TFLOPS table", f"{peak:g} ({peak_src})",
+                     RED_NO if "unrecognised" in peak_src else GREEN_OK))
+        if n_live == 0 and cap is None:
+            rows.append(("memory accounting",
+                         f"{backend} backend exposes neither memory_stats() "
+                         "nor an HBM table entry: live Mem/* watermarks and "
+                         "HBM headroom are OFF (compiled memory_analysis() "
+                         "still works)", RED_NO))
     return rows
 
 

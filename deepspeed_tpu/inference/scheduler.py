@@ -305,10 +305,20 @@ class ContinuousBatchingScheduler:
         temp = jnp.float32(self.temperature)
         t_run0 = time.monotonic()
 
+        from deepspeed_tpu.telemetry.bus import (
+            KIND_SERVE_ADMIT,
+            KIND_SERVE_EVICT,
+            KIND_SERVE_FIRST_TOKEN,
+            publish,
+        )
+
         def finish(lane_no: int, lane: _Lane):
             lane.comp.t_done = time.monotonic()
             stats.completions.append(lane.comp)
             lanes[lane_no] = None
+            publish(KIND_SERVE_EVICT, request_id=lane.req.request_id,
+                    lane=lane_no, tokens=lane.emitted,
+                    queue_depth=len(self._pending))
 
         def emit(lane_no: int, lane: _Lane, token: int) -> bool:
             """Record one token; returns True when the sequence is done."""
@@ -317,6 +327,9 @@ class ContinuousBatchingScheduler:
             lane.emitted += 1
             if lane.emitted == 1:
                 lane.comp.t_first_token = now
+                publish(KIND_SERVE_FIRST_TOKEN,
+                        request_id=lane.req.request_id, lane=lane_no,
+                        ttft_s=now - lane.comp.t_submit)
             done = (lane.emitted >= lane.req.max_new_tokens
                     or (lane.req.eos_token_id is not None
                         and token == lane.req.eos_token_id))
@@ -335,6 +348,10 @@ class ContinuousBatchingScheduler:
                                       prompt_len=len(req.prompt),
                                       t_submit=t_submit)
                     comp.t_admit = time.monotonic()
+                    publish(KIND_SERVE_ADMIT, request_id=req.request_id,
+                            lane=lane_no, prompt_len=len(req.prompt),
+                            queue_wait_s=comp.t_admit - t_submit,
+                            queue_depth=len(self._pending))
                     first_tok, sub_cache = self._admit_prefill(req)
                     cache = self._splice(cache, sub_cache, lane_no)
                     tok[lane_no] = first_tok

@@ -319,6 +319,27 @@ def main(argv=None) -> int:
         for p in procs:
             reap_process_group(p)
         rc = 1
+    if rc != 0:
+        # sweep per-rank flight-recorder dumps into one crash report
+        # (workers inherit DS_TPU_TELEMETRY_DIR from this process' env);
+        # best-effort — forensics must not change the exit code
+        from deepspeed_tpu.telemetry.crash_report import (
+            TELEMETRY_DIR_ENV,
+            sweep_blackbox_dumps,
+        )
+
+        tdir = os.environ.get(TELEMETRY_DIR_ENV)
+        if tdir:
+            try:
+                report = sweep_blackbox_dumps(tdir)
+            except Exception as e:
+                logger.warning(f"blackbox sweep failed: {e}")
+                report = None
+            if report is not None:
+                logger.error(
+                    f"crash report: {report['path']} — "
+                    f"{report['num_ranks']} rank(s), "
+                    f"reasons={report['reasons']}")
     return rc
 
 

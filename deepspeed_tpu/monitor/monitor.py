@@ -37,6 +37,11 @@ class Monitor:
     def write_events(self, event_list: List[Event]):
         raise NotImplementedError
 
+    def flush(self):
+        """Push buffered rows to durable storage. Called from the flight
+        recorder's crash-dump path (signal handlers / excepthook), so it
+        must be safe to invoke at any moment and must not raise."""
+
     def close(self):
         """Flush and release backend resources (idempotent)."""
 
@@ -105,7 +110,12 @@ class WandbMonitor(Monitor):
 
 
 class CsvMonitor(Monitor):
-    """reference monitor/csv_monitor.py — one csv file per event tag."""
+    """reference monitor/csv_monitor.py — one csv file per event tag.
+
+    Handles stay open across batches (one open per tag per run, not per
+    batch) and every batch ends with ``flush()``-to-OS, so a crash-dump
+    ``flush()``/``close()`` from a signal handler leaves complete rows on
+    disk instead of a truncated csv."""
 
     def __init__(self, config):
         super().__init__(config)
@@ -122,36 +132,63 @@ class CsvMonitor(Monitor):
         return os.path.join(self.log_dir,
                             tag.replace("/", "_").replace(" ", "_") + ".csv")
 
+    def _file(self, tag: str):
+        f = self._files.get(tag)
+        if f is None or f.closed:
+            path = self._path(tag)
+            new = not os.path.exists(path) or os.path.getsize(path) == 0
+            f = open(path, "a", newline="")
+            if new:
+                csv.writer(f).writerow(["step", tag])
+            self._files[tag] = f
+        return f
+
     def write_events(self, event_list: List[Event]):
         if self.log_dir is None:
             return
-        # one open per tag per batch, not per event: a per-step counter
-        # export is a dozen events over a handful of tags, and open/close
-        # per row is the dominant cost on networked filesystems
         by_tag = {}
         for tag, value, step in event_list:
             by_tag.setdefault(tag, []).append((step, value))
         for tag, rows in by_tag.items():
-            path = self._path(tag)
-            new = not os.path.exists(path)
-            with open(path, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", tag])
-                w.writerows(rows)
+            f = self._file(tag)
+            csv.writer(f).writerows(rows)
+            # flush per batch: readers (tests, tail -f) see whole rows,
+            # and an abrupt kill loses at most the in-flight batch
+            f.flush()
+
+    def flush(self):
+        for f in self._files.values():
+            try:
+                f.flush()
+                os.fsync(f.fileno())
+            except Exception:
+                pass  # crash path: durability is best-effort
 
     def close(self):
-        # nothing held open between batches; disable further writes
+        self.flush()
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files = {}
         self.log_dir = None
 
 
 class MonitorMaster(Monitor):
-    """Fan-out to all enabled backends (reference monitor/monitor.py:24)."""
+    """Fan-out to all enabled backends (reference monitor/monitor.py:24).
+
+    Backends are isolated from each other: one backend raising (a full
+    disk under the csv dir, a wandb network error) must not cost the
+    others their events — the failure is logged once per backend and the
+    fan-out continues."""
 
     def __init__(self, ds_config):
         self.tb_monitor: Optional[TensorBoardMonitor] = None
         self.wandb_monitor: Optional[WandbMonitor] = None
         self.csv_monitor: Optional[CsvMonitor] = None
+        self.backends: List[Monitor] = []
+        self._warned = set()  # backend ids already logged as failing
         self.enabled = False
 
         tb_cfg = getattr(ds_config, "tensorboard", None)
@@ -160,20 +197,35 @@ class MonitorMaster(Monitor):
         if jax.process_index() == 0:
             if tb_cfg is not None and tb_cfg.enabled:
                 self.tb_monitor = TensorBoardMonitor(tb_cfg)
-                self.enabled = True
+                self.add_backend(self.tb_monitor)
             if wandb_cfg is not None and wandb_cfg.enabled:
                 self.wandb_monitor = WandbMonitor(wandb_cfg)
-                self.enabled = True
+                self.add_backend(self.wandb_monitor)
             if csv_cfg is not None and csv_cfg.enabled:
                 self.csv_monitor = CsvMonitor(csv_cfg)
-                self.enabled = True
+                self.add_backend(self.csv_monitor)
+
+    def add_backend(self, monitor: Monitor):
+        """Register an extra fan-out target (tests use fakes; the flight
+        recorder does not go through here — it subscribes to the bus)."""
+        self.backends.append(monitor)
+        self.enabled = True
+
+    def _guard(self, m: Monitor, op, *args):
+        try:
+            op(*args)
+        except Exception as e:
+            if id(m) not in self._warned:
+                self._warned.add(id(m))
+                logger.warning("monitor backend %s failed (%s: %s); "
+                               "continuing with the others",
+                               type(m).__name__, type(e).__name__, e)
 
     def write_events(self, event_list: List[Event]):
         if jax.process_index() != 0:
             return
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
-            if m is not None:
-                m.write_events(event_list)
+        for m in self.backends:
+            self._guard(m, m.write_events, event_list)
 
     def write_counters(self, prefix: str, counters, step: int):
         """Export a dict of cumulative counters as ``prefix/name`` scalars
@@ -182,13 +234,18 @@ class MonitorMaster(Monitor):
         if counters:
             self.write_events(counter_events(prefix, counters, step))
 
+    def flush(self):
+        """Crash-dump hook (flight recorder flush_hooks): push every
+        backend's buffers to disk without closing anything."""
+        for m in self.backends:
+            self._guard(m, m.flush)
+
     def close(self):
         """Flush/close every backend (graceful-shutdown path). Idempotent;
         later ``write_events`` calls become no-ops."""
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
-            if m is not None:
-                try:
-                    m.close()
-                except Exception as e:  # closing must never mask shutdown
-                    logger.warning("monitor close failed: %s", e)
+        for m in self.backends:
+            try:
+                m.close()
+            except Exception as e:  # closing must never mask shutdown
+                logger.warning("monitor close failed: %s", e)
         self.enabled = False

@@ -226,7 +226,10 @@ def _decline_demanded_ring(reason: str) -> None:
     training did — docs/DIVERGENCES.md, Inference section)."""
     import warnings
 
+    from deepspeed_tpu.telemetry.bus import KIND_RING_DECLINE, publish
+
     RING_DECLINES.append(reason)
+    publish(KIND_RING_DECLINE, severity="warning", reason=reason)
     warnings.warn(
         "sparse_kv_cache=True but the ring KV cache is NOT engaged; decode "
         f"falls back to DENSE attention: {reason}", RuntimeWarning,

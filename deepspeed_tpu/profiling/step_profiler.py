@@ -138,6 +138,20 @@ class StepProfiler:
         # subsystem gauges merged into perf_counters (the engine feeds
         # the data-pipeline prefetch queue-depth/starvation stats here)
         self.aux_counters: Dict[str, float] = {}
+        # HBM accounting (docs/observability.md "Memory accounting"):
+        # compiled-step memory_analysis captured once per window, plus
+        # live allocator watermarks maxed over the windowed steps
+        self._memory: Optional[Dict[str, float]] = None
+        self._live_mem_peak: Dict[str, float] = {}
+
+    def set_memory(self, mem: Optional[Dict[str, float]]) -> None:
+        """Record the compiled-step memory breakdown (once; later calls
+        with None or after a first set are ignored)."""
+        if mem and self._memory is None:
+            self._memory = {str(k): float(v) for k, v in mem.items()}
+
+    def has_memory(self) -> bool:
+        return self._memory is not None
 
     def set_aux_counters(self, counters: Dict[str, float]) -> None:
         """Attach external gauges to the ``Perf/*`` export. Last write
@@ -206,7 +220,10 @@ class StepProfiler:
             self._emit_event(name, t0, t1, cat="phase")
 
     def end_step(self, step: Optional[int] = None, comm_counters=None,
-                 cost_cb: Optional[Callable[[], Optional[Dict]]] = None) -> None:
+                 cost_cb: Optional[Callable[[], Optional[Dict]]] = None,
+                 mem_cb: Optional[Callable[[], Optional[Dict]]] = None,
+                 live_mem_cb: Optional[Callable[[], Optional[Dict]]] = None
+                 ) -> None:
         if not self._in_step:
             return
         self._fence()
@@ -237,6 +254,26 @@ class StepProfiler:
                 cost = None
             if cost:
                 self.set_cost("optimizer_step", cost)
+        # compiled-step memory, once per window — same placement as the
+        # cost callback: the lowering is a compile-cache hit but still
+        # host work that must not land inside a measured span
+        if mem_cb is not None and self._memory is None:
+            try:
+                self.set_memory(mem_cb())
+            except Exception as e:  # pragma: no cover
+                logger.warning(
+                    f"step_profiler: memory callback failed: {e}")
+        # live allocator watermarks: a host-local PJRT query (no sync),
+        # sampled inside the already-fenced window and maxed over steps
+        if live_mem_cb is not None:
+            try:
+                stats = live_mem_cb()
+            except Exception:  # pragma: no cover
+                stats = None
+            if stats:
+                for k, v in stats.items():
+                    self._live_mem_peak[k] = max(
+                        self._live_mem_peak.get(k, 0.0), float(v))
         if self._step_idx >= self.window.stop - 1:
             self.finalize(comm_counters=comm_counters)
 
@@ -318,6 +355,19 @@ class StepProfiler:
             if mean_s > 0 else 0.0,
             "costs": {k: dict(v) for k, v in self._costs.items()},
         }
+        if self._memory is not None:
+            out["memory"] = dict(self._memory)
+        if self._live_mem_peak:
+            out["live_memory_peak"] = dict(self._live_mem_peak)
+        return out
+
+    def mem_counters(self) -> Dict[str, float]:
+        """Flat ``Mem/*`` counters: the compiled-step breakdown plus
+        ``live_``-prefixed allocator watermarks (empty on backends
+        without either source — CPU with no mem_cb set)."""
+        out = {k: float(v) for k, v in (self._memory or {}).items()}
+        for k, v in self._live_mem_peak.items():
+            out[f"live_{k}"] = float(v)
         return out
 
     def perf_counters(self) -> Dict[str, float]:
@@ -438,6 +488,9 @@ class StepProfiler:
             events = counter_events("Perf", self.perf_counters(), step)
             if comm_counters:
                 events += counter_events("Comm", comm_counters, step)
+            mem = self.mem_counters()
+            if mem:
+                events += counter_events("Mem", mem, step)
             if events:
                 self.monitor.write_events(events)
         if summary.get("steps_profiled"):

@@ -412,6 +412,45 @@ class SentinelConfig(ConfigModel):
 
 
 @dataclass
+class TelemetryConfig(ConfigModel):
+    """Telemetry bus + crash-forensics flight recorder
+    (docs/observability.md "Telemetry events" / "Flight recorder").
+
+    Enabled by default: the recorder is an in-memory ring (bounded, host
+    timers only — no fences, no device pulls), so the healthy path pays
+    microseconds per step and gains zero syncs. Blackbox dumps are
+    written only when ``dump_dir`` resolves (the config field, else the
+    ``DS_TPU_TELEMETRY_DIR`` env the elastic agent / launcher export);
+    crash handlers (SIGTERM / excepthook / atexit) install only then."""
+
+    enabled: bool = True
+    ring_steps: int = 64          # step records kept (>= 32 for forensics)
+    ring_events: int = 256        # bus events kept
+    dump_dir: Optional[str] = None  # None -> DS_TPU_TELEMETRY_DIR env
+    # live device.memory_stats() watermarks in each step record (host
+    # query, no sync; auto-disabled after the first None on CPU)
+    sample_memory: bool = True
+    # fatal signals that trigger a dump (chained before any previous
+    # handler, e.g. graceful_shutdown's flag-setter)
+    dump_signals: List[str] = field(default_factory=lambda: ["SIGTERM"])
+
+    def __post_init__validate__(self):
+        if self.ring_steps < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.ring_steps must be >= 1, got {self.ring_steps}")
+        if self.ring_events < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.ring_events must be >= 1, got "
+                f"{self.ring_events}")
+        import signal as _signal
+
+        for name in self.dump_signals:
+            if not hasattr(_signal, str(name)):
+                raise DeepSpeedConfigError(
+                    f"telemetry.dump_signals: unknown signal {name!r}")
+
+
+@dataclass
 class MeshConfig(ConfigModel):
     """TPU device-mesh axis sizes. -1 on ``dp`` means "use all remaining
     devices". No reference analogue: replaces mpu/process-group plumbing
@@ -623,6 +662,7 @@ class DeepSpeedConfig:
         self.graceful_shutdown = GracefulShutdownConfig.from_dict(
             pd.get(C.GRACEFUL_SHUTDOWN, {}))
         self.sentinel = SentinelConfig.from_dict(pd.get(C.SENTINEL, {}))
+        self.telemetry = TelemetryConfig.from_dict(pd.get(C.TELEMETRY, {}))
 
         if self.dp_world_size is not None:
             self._resolve_batch_triad(self.dp_world_size)

@@ -141,6 +141,11 @@ DIVERGENCE_EXIT_CODE_DEFAULT = 13
 # wedged collective), so it must differ from the divergence code
 SENTINEL_HANG_EXIT_CODE_DEFAULT = 14
 
+# Telemetry bus + crash-forensics flight recorder block
+# (docs/observability.md "Flight recorder"). The dump-dir env var lives
+# in telemetry/crash_report.py (jax-free) so supervisors share it.
+TELEMETRY = "telemetry"
+
 DATALOADER_DROP_LAST = "dataloader_drop_last"
 # True matches what deepspeed_io has always DONE (a hard-coded drop_last
 # that ignored this knob); the knob is now honored, and False engages the

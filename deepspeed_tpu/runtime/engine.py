@@ -457,8 +457,57 @@ class DeepSpeedEngine:
                     timeout_s=config.sentinel.hang_timeout_s,
                     action=config.sentinel.hang_action,
                     exit_code=config.sentinel.hang_exit_code,
-                    on_fire=self.sentinel.note_watchdog_fire)
+                    on_fire=self._on_watchdog_fire)
                 self._watchdog.start()
+
+        # telemetry bus + crash-forensics flight recorder (default-on;
+        # docs/observability.md "Flight recorder"). The ring always
+        # records in memory — host timers only, no fences, no device
+        # pulls. Blackbox dumps + crash handlers engage only when a dump
+        # dir resolves (config, else DS_TPU_TELEMETRY_DIR exported by the
+        # elastic agent / launcher), so ordinary runs never touch
+        # signals, sys.excepthook or disk.
+        self.flight_recorder = None
+        self._telemetry_uninstall = None
+        self._live_mem_sampling = False
+        self._mem_static_captured = False
+        if config.telemetry.enabled:
+            from deepspeed_tpu.telemetry import (
+                TELEMETRY_DIR_ENV,
+                FlightRecorder,
+                install_crash_handlers,
+                telemetry_bus,
+            )
+
+            tcfg = config.telemetry
+            rank = jax.process_index()
+            telemetry_bus.set_rank(rank)
+            dump_dir = tcfg.dump_dir or os.environ.get(TELEMETRY_DIR_ENV)
+            self.flight_recorder = FlightRecorder(
+                ring_steps=tcfg.ring_steps, ring_events=tcfg.ring_events,
+                dump_dir=dump_dir, rank=rank, bus=telemetry_bus)
+            dev = jax.devices()[0]
+            self.flight_recorder.set_static(
+                backend=jax.default_backend(),
+                device_kind=str(getattr(dev, "device_kind", dev)),
+                num_devices=jax.device_count(),
+                num_processes=jax.process_count(),
+                train_batch_size=self.train_batch_size,
+                micro_batch_size=self.train_micro_batch_size_per_gpu,
+                gradient_accumulation_steps=(
+                    self.gradient_accumulation_steps),
+            )
+            self._live_mem_sampling = bool(tcfg.sample_memory)
+            if getattr(self.monitor, "enabled", False):
+                # CsvMonitor durability: counter csvs hit disk before any
+                # blackbox dump (signal/excepthook paths included)
+                self.flight_recorder.add_flush_hook(self.monitor.flush)
+            if dump_dir:
+                # installed AFTER graceful_shutdown's handlers: on SIGTERM
+                # the dump runs first, then chains to the flag-setter
+                self._telemetry_uninstall = install_crash_handlers(
+                    self.flight_recorder,
+                    signals=tuple(tcfg.dump_signals))
 
         # module-level activation checkpointing (reference engine.py:818
         # _configure_checkpointing): models that call
@@ -1408,15 +1457,21 @@ class DeepSpeedEngine:
     # train API (reference forward/backward/step protocol)
     # ------------------------------------------------------------------
     def _prof_phase(self, name: str):
-        """Step-profiler phase context; the shared no-op when profiling is
-        off (one attribute check on the healthy path, no syncs)."""
-        if self.step_profiler is None:
-            return _NULL_PROF_CTX
-        return self.step_profiler.phase(name)
+        """Step-profiler phase context; when the flight recorder is on it
+        wraps the same context to accumulate host dispatch time per phase
+        (perf_counter only — the recorder never adds a fence). The shared
+        no-op when both are off (one attribute check, no syncs)."""
+        inner = (None if self.step_profiler is None
+                 else self.step_profiler.phase(name))
+        if self.flight_recorder is not None:
+            return self.flight_recorder.phase(name, inner)
+        return inner if inner is not None else _NULL_PROF_CTX
 
     def _prof_begin_step(self):
         if self.step_profiler is not None:
             self.step_profiler.begin_step(self.global_steps)
+        if self.flight_recorder is not None:
+            self.flight_recorder.begin_step(self.global_steps)
 
     def _prof_end_step(self):
         if self.step_profiler is not None:
@@ -1429,7 +1484,63 @@ class DeepSpeedEngine:
             # end_step closes the window and exports
             self.step_profiler.end_step(
                 self.global_steps, comm_counters=comms_logger.counters,
-                cost_cb=self.compiled_step_cost)
+                cost_cb=self.compiled_step_cost,
+                mem_cb=self.compiled_step_memory,
+                live_mem_cb=self._live_memory_sample)
+
+    def _live_memory_sample(self) -> Optional[Dict[str, int]]:
+        """Allocator watermarks for the flight recorder / profiler
+        ``Mem/*`` export. A host-local PJRT query, not a device sync;
+        permanently disabled after the first None (CPU backend) so the
+        healthy path never re-asks a backend that has no answer."""
+        if not self._live_mem_sampling:
+            return None
+        from deepspeed_tpu.telemetry.memory import live_memory_stats
+
+        stats = live_memory_stats()
+        if stats is None:
+            self._live_mem_sampling = False
+        return stats
+
+    def compiled_step_memory(self) -> Optional[Dict[str, float]]:
+        """XLA ``memory_analysis()`` of one optimizer step's compiled
+        program(s): per-program argument/output/temp/aliased bytes plus
+        the headline ``peak_working_set_bytes`` (max over sequentially-run
+        programs), or None before the step has compiled. Same aval
+        discipline as :meth:`compiled_step_cost` — lowering the live
+        shapes is a compile-cache hit, captured once per program set."""
+        from deepspeed_tpu.telemetry.memory import (
+            compiled_memory_analysis,
+            summarize_program_memory,
+        )
+
+        aval = partial(jax.tree.map,
+                       lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype))
+        if self._last_batch_aval is None or not self._initialized:
+            return None
+        scale = self._ls_state.scale if self.fp16_enabled else self._unit_scale
+        lr_factor = jnp.float32(1.0)
+        try:
+            if self._train_step_fn is not None:
+                mem = compiled_memory_analysis(
+                    self._train_step_fn, aval(self._params),
+                    aval(self._opt_state), aval(self._ls_state),
+                    self._last_batch_aval, aval(self._rng),
+                    self.micro_steps, lr_factor)
+                return summarize_program_memory({"train_step": mem})
+            if self._fwd_bwd_fn is None or self._apply_fn is None:
+                return None
+            fwd = compiled_memory_analysis(
+                self._fwd_bwd_fn, aval(self._params), aval(self._acc_grads),
+                self._last_batch_aval, aval(self._rng), self.micro_steps,
+                aval(scale))
+            app = compiled_memory_analysis(
+                self._apply_fn, aval(self._params), aval(self._opt_state),
+                aval(self._acc_grads), aval(self._ls_state), lr_factor)
+            return summarize_program_memory({"fwd_bwd": fwd, "apply": app})
+        except Exception as e:
+            logger.warning(f"compiled_step_memory unavailable: {e}")
+            return None
 
     def compiled_step_cost(self) -> Optional[Dict[str, float]]:
         """XLA cost analysis of one optimizer step's compiled program(s):
@@ -1733,20 +1844,58 @@ class DeepSpeedEngine:
                 self._autotune_metric_path = None  # write once
         if self.global_steps % self._config.steps_per_print == 0:
             self._report_progress()
-        # gate on enabled BEFORE the float() conversions: pulling the loss
-        # to host costs a device sync per step
-        if (self.monitor is not None
-                and getattr(self.monitor, "enabled", True) and step_losses):
+        # host-materialize the mean loss ONCE, and only for consumers that
+        # were going to pay the device sync anyway (monitor export,
+        # sentinel verdict); the flight recorder reuses it but never
+        # triggers the pull itself (zero-added-syncs discipline)
+        monitor_on = (self.monitor is not None
+                      and getattr(self.monitor, "enabled", True))
+        host_loss = None
+        if step_losses and (monitor_on or self.sentinel is not None):
+            host_loss = float(np.mean([float(l) for l in step_losses]))
+        if monitor_on and host_loss is not None:
             self.monitor.write_events(
-                [("Train/Samples/train_loss",
-                  float(np.mean([float(l) for l in step_losses])),
+                [("Train/Samples/train_loss", host_loss,
                   self.global_samples)]
             )
+        if self.flight_recorder is not None:
+            self._record_flight_step(host_loss, update_skipped)
         if self.sentinel is not None:
             with self._prof_phase("sentinel"):
-                self._sentinel_observe(update_skipped, step_losses)
+                self._sentinel_observe(update_skipped, host_loss)
         if self._preempt_signum is not None:
             self._graceful_shutdown()
+
+    def _record_flight_step(self, host_loss, update_skipped):
+        """Append this optimizer step to the flight recorder ring —
+        BEFORE the sentinel verdict, so a diverging step's own loss is in
+        the blackbox. Every field is already host-side: loss from the
+        shared materialization above, grad-norm only when the sentinel
+        already paid its ``float()``, comm/feed counters are plain host
+        dicts, live memory is a host-local allocator query."""
+        grad_norm = (self.get_global_grad_norm()
+                     if self.sentinel is not None else None)
+        if not self._mem_static_captured:
+            # once, after the first step compiled: the static HBM budget
+            # (memory_analysis() breakdown) rides in every blackbox even
+            # on backends whose live memory_stats() is None (CPU). AOT
+            # re-lowering with the same avals is an executable-cache hit.
+            self._mem_static_captured = True
+            try:
+                mem = self.compiled_step_memory()
+                if mem:
+                    self.flight_recorder.set_static(compiled_memory=mem)
+            except Exception:
+                pass
+        feed = None
+        loader = self.training_dataloader
+        if loader is not None and hasattr(loader, "counters"):
+            feed = loader.counters()
+        extra = {"skipped": True} if update_skipped else {}
+        self.flight_recorder.record_step(
+            self.global_steps, loss=host_loss, grad_norm=grad_norm,
+            comm=comms_logger.counters() or None, feed=feed,
+            mem=self._live_memory_sample(), **extra)
 
     def _apply_curriculum(self, batch):
         """Truncate sequence tensors to the scheduled difficulty (one
@@ -1967,12 +2116,25 @@ class DeepSpeedEngine:
         self.save_checkpoint(cfg.save_dir, tag=cfg.tag)
         self.ft_stats["graceful_shutdowns"] += 1
         self._emit_ft_events()
+        self._publish_telemetry(
+            "shutdown.graceful",
+            signal=signal_module.Signals(signum).name, tag=str(cfg.tag))
         if cfg.exit_after_save:
             if self._watchdog is not None:
                 self._watchdog.stop()
             if self.monitor is not None:
                 # flush/close TB, wandb and CSV before the process dies
                 self.monitor.close()
+            if self._telemetry_uninstall is not None:
+                # a clean preemption exit is not a crash: drop the hooks
+                # so the SystemExit below leaves no blackbox behind
+                self._telemetry_uninstall()
+                self._telemetry_uninstall = None
+            if self.flight_recorder is not None:
+                # the SIGTERM handler already dumped before it could know
+                # the grace save would commit; the checkpoint is the real
+                # evidence now, so withdraw the stale blackbox
+                self.flight_recorder.retract_dump()
             raise SystemExit(cfg.exit_code)
 
     def _emit_ft_events(self):
@@ -1990,28 +2152,37 @@ class DeepSpeedEngine:
     # training health sentinel (docs/recovery.md "Divergence and hang
     # recovery"): detect → skip → rollback → diverge
     # ------------------------------------------------------------------
-    def _sentinel_observe(self, update_skipped, step_losses):
+    def _sentinel_observe(self, update_skipped, host_loss):
         from deepspeed_tpu.runtime.sentinel import (
             VERDICT_ANOMALY,
             VERDICT_DIVERGED,
             VERDICT_ROLLBACK,
         )
 
-        loss = None
-        if step_losses:
-            loss = float(np.mean([float(l) for l in step_losses]))
         verdict, reason = self.sentinel.observe(
-            loss=loss, grad_norm=self.get_global_grad_norm(),
+            loss=host_loss, grad_norm=self.get_global_grad_norm(),
             update_skipped=update_skipped, fp16=self.fp16_enabled,
             step=self.global_steps)
         if verdict == VERDICT_ANOMALY:
             logger.warning("sentinel: %s", reason)
+            self._publish_telemetry(
+                "sentinel.skip", severity="warning", reason=reason)
         elif verdict == VERDICT_ROLLBACK:
             logger.warning("sentinel: %s", reason)
             self._sentinel_rollback(reason)
         elif verdict == VERDICT_DIVERGED:
             self._sentinel_divergence(reason)  # raises
         self._emit_sentinel_events()
+
+    def _publish_telemetry(self, kind, severity="info", **payload):
+        """Bus publish, rank-tagged and step-stamped; a silent no-op when
+        telemetry is disabled (the recorder is the only subscriber the
+        engine guarantees, so no recorder means nobody is listening)."""
+        if self.flight_recorder is None:
+            return
+        from deepspeed_tpu.telemetry import publish
+
+        publish(kind, step=self.global_steps, severity=severity, **payload)
 
     def _sentinel_rollback(self, reason):
         """Restore the newest manifest-valid checkpoint and reseed the
@@ -2027,6 +2198,10 @@ class DeepSpeedEngine:
                           f"to in {load_dir}" if load_dir else
                           "; sentinel.rollback_dir is not set"))
         self.sentinel.note_rollback()
+        self._publish_telemetry(
+            "sentinel.rollback", severity="warning", reason=reason,
+            tag=str(tag),
+            rollbacks_used=self.sentinel.stats["rollbacks"])
         log_dist(
             f"sentinel: rolling back to manifest-valid tag {tag} "
             f"({self.sentinel.stats['rollbacks']}/{cfg.rollback_budget} "
@@ -2046,15 +2221,41 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.sentinel import DivergenceError
 
         cfg = self._config.sentinel
+        self._publish_telemetry(
+            "sentinel.diverged", severity="fatal", reason=reason)
         self._emit_sentinel_events()
         if self._watchdog is not None:
             self._watchdog.stop()
         logger.error("sentinel: training diverged: %s", reason)
-        raise DivergenceError(
+        err = DivergenceError(
             f"training diverged: {reason}. Workers should exit with code "
             f"{cfg.divergence_exit_code} (DivergenceError.exit_code) so "
             f"the elastic agent stops restart-looping into it.",
             cfg.divergence_exit_code)
+        if self.flight_recorder is not None:
+            # dump HERE, not in excepthook: the sanctioned worker exit is
+            # a *caught* DivergenceError + sys.exit(13), which never
+            # reaches sys.excepthook (flight_recorder.py trigger matrix)
+            self.flight_recorder.dump(
+                "divergence", exit_code=cfg.divergence_exit_code, exc=err)
+        raise err
+
+    def _on_watchdog_fire(self, dump: str = ""):
+        """HangWatchdog ``on_fire``: blackbox first (an ``abort`` hang
+        action is ``os._exit``, which skips atexit), then the sentinel's
+        own bookkeeping."""
+        cfg = self._config.sentinel
+        fatal = cfg.hang_action == "abort"
+        self._publish_telemetry(
+            "sentinel.watchdog_fire",
+            severity="fatal" if fatal else "warning",
+            timeout_s=cfg.hang_timeout_s, action=cfg.hang_action)
+        if self.flight_recorder is not None and fatal:
+            # a "warn" fire is survivable — dumping then would spend the
+            # first-reason-wins slot a later real crash needs
+            self.flight_recorder.dump(
+                "hang_watchdog", exit_code=cfg.hang_exit_code)
+        self.sentinel.note_watchdog_fire(dump)
 
     def _emit_sentinel_events(self):
         """Export the sentinel counters as ``Sentinel/*`` monitor events
@@ -2205,6 +2406,7 @@ class DeepSpeedEngine:
         self.checkpoint_engine.commit(tag)
         if save_latest:
             ckpt_manifest.write_latest(save_dir, tag)
+        self._publish_telemetry("checkpoint.commit", tag=str(tag))
         self.ft_stats["ckpt_saves"] += 1
         self._gc_checkpoints(save_dir)
         self._emit_ft_events()
@@ -2273,6 +2475,9 @@ class DeepSpeedEngine:
                 f"({'; '.join(problems)}) and no previous valid tag "
                 f"exists to fall back to")
         self.ft_stats["ckpt_fallbacks"] += 1
+        self._publish_telemetry(
+            "checkpoint.fallback", severity="warning", tag=str(tag),
+            fallback=str(fallback), problems="; ".join(problems))
         log_dist(f"[ckpt] falling back: {tag} -> {fallback}", ranks=[0])
         return fallback
 

@@ -47,7 +47,7 @@ from deepspeed_tpu.runtime.optimizer import build_optimizer
 from deepspeed_tpu.runtime.pipe.module import PipelineModule, TiedLayerSpec
 from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
 from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import ThroughputTimer
 
 # shared no-op phase context when the step profiler is off (zero syncs)
@@ -196,6 +196,10 @@ class PipelineEngine:
             from deepspeed_tpu.profiling.step_profiler import StepProfiler
 
             self.step_profiler = StepProfiler(config.step_profiler)
+        # per-stage compiled programs noted (as avals) during the first
+        # profiled step so compiled_memory_analysis can re-lower them as
+        # compile-cache hits AFTER the envelope closes (Mem/* export)
+        self._mem_programs: Dict[str, Tuple[Any, tuple]] = {}
 
         log_dist(
             f"PipelineEngine: stages={self.num_stages}, "
@@ -450,6 +454,8 @@ class PipelineEngine:
                         if s < S - 1:
                             fargs = (self._params[s], x, rngs[s][m]) + (
                                 (theta,) if theta is not None else ())
+                            self._note_mem_call(f"fwd_stage{s}",
+                                                self._fwd_fn(s), fargs)
                             out = self._fwd_fn(s)(*fargs)
                             acts[(s + 1, m)] = jax.device_put(
                                 out, self.stage_topos[s + 1].batch_sharding())
@@ -459,14 +465,19 @@ class PipelineEngine:
                         x = acts[(s, m)]
                         textra = (theta,) if theta is not None else ()
                         if s == S - 1:
-                            gp, gx, loss = self._bwd_fn(s)(
-                                self._params[s], x, labels[m], rngs[s][m],
-                                *textra)
+                            bargs = (self._params[s], x, labels[m],
+                                     rngs[s][m]) + textra
+                            self._note_mem_call(f"bwd_stage{s}",
+                                                self._bwd_fn(s), bargs)
+                            gp, gx, loss = self._bwd_fn(s)(*bargs)
                             losses.append(loss)
                         else:
                             g = grads_in.pop(m)
-                            gp, gx = self._bwd_fn(s)(
-                                self._params[s], x, g, rngs[s][m], *textra)
+                            bargs = (self._params[s], x, g,
+                                     rngs[s][m]) + textra
+                            self._note_mem_call(f"bwd_stage{s}",
+                                                self._bwd_fn(s), bargs)
+                            gp, gx = self._bwd_fn(s)(*bargs)
                         self._acc_grads[s] = jax.tree.map(
                             jnp.add, self._acc_grads[s], gp)
                         if s > 0:
@@ -487,11 +498,49 @@ class PipelineEngine:
         self.tput_timer.stop(global_step=True)
         if prof is not None:
             prof.end_step(self.global_steps)
+            if self._mem_programs and not prof.has_memory():
+                self._capture_compiled_memory()
         mean_loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
         if self.global_steps % self._config.steps_per_print == 0:
             log_dist(f"pipe step={self.global_steps} loss={float(mean_loss):.4f}",
                      ranks=[0])
         return mean_loss
+
+    def _note_mem_call(self, key: str, fn, args) -> None:
+        """Remember (fn, avals-of-args) for a compiled stage program so
+        its ``memory_analysis()`` can be read after the step. Avals only
+        — holding the concrete arrays would pin a whole step's buffers.
+        Active solely until the profiler has its memory breakdown."""
+        prof = self.step_profiler
+        if (prof is None or prof.has_memory()
+                or key in self._mem_programs):
+            return
+        avals = tuple(
+            jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                if hasattr(x, "shape") else x, a)
+            for a in args)
+        self._mem_programs[key] = (fn, avals)
+
+    def _capture_compiled_memory(self) -> None:
+        """Per-stage XLA memory breakdown -> profiler ``Mem/*`` export.
+        Each lowering is a compile-cache hit (same fn, same avals as the
+        step that just ran); runs after the fenced envelope closed, so it
+        is never charged to a measured span."""
+        from deepspeed_tpu.telemetry.memory import (
+            compiled_memory_analysis,
+            summarize_program_memory,
+        )
+
+        programs, self._mem_programs = self._mem_programs, {}
+        try:
+            mems = {key: compiled_memory_analysis(fn, *avals)
+                    for key, (fn, avals) in programs.items()}
+            if mems:
+                self.step_profiler.set_memory(
+                    summarize_program_memory(mems))
+        except Exception as e:  # pragma: no cover - backend w/o the API
+            logger.warning(f"pipe compiled_step memory unavailable: {e}")
 
     def eval_batch(self, batch):
         """Wavefront forward (reference InferenceSchedule); returns last-stage
@@ -561,9 +610,11 @@ class PipelineEngine:
         else:
             clip = 1.0
         for s in range(self.num_stages):
+            aargs = (self._params[s], self._opt_states[s],
+                     self._acc_grads[s], jnp.float32(clip * factor))
+            self._note_mem_call(f"apply_stage{s}", self._apply_fn(s), aargs)
             self._params[s], self._opt_states[s], self._acc_grads[s] = (
-                self._apply_fn(s)(self._params[s], self._opt_states[s],
-                                  self._acc_grads[s], jnp.float32(clip * factor))
+                self._apply_fn(s)(*aargs)
             )
 
     # ------------------------------------------------------------------

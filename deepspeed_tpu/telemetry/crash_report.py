@@ -1,0 +1,146 @@
+"""Run-level crash report: sweep per-rank blackbox dumps into one file.
+
+After a worker (or a whole pod) dies, each rank's flight recorder has
+left a ``blackbox-rank{k}.json`` in the telemetry dir. The elastic agent
+(single-host supervision) and the launcher (multi-host fan-out) call
+``sweep_blackbox_dumps`` to merge them into ``crash-report.json``: crc
+verification per dump, a per-rank summary table, and a cross-rank merged
+event tail ordered by wall clock — "what was happening in the last N
+steps when rank 3 died with exit 13", answerable from one file.
+
+stdlib-only: supervisors import this without a jax backend.
+"""
+
+import glob
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.telemetry.flight_recorder import (
+    BLACKBOX_SCHEMA,
+    blackbox_crc,
+)
+
+# Workers and supervisors rendezvous on the telemetry dir via this env
+# var (the agent/launcher export it; the engine's TelemetryConfig reads
+# it as the dump_dir default).
+TELEMETRY_DIR_ENV = "DS_TPU_TELEMETRY_DIR"
+
+CRASH_REPORT_SCHEMA = "ds-tpu-crash-report/1"
+_RANK_RE = re.compile(r"blackbox-rank(\d+)\.json$")
+
+
+def verify_blackbox(payload: Dict[str, Any]) -> bool:
+    """Recompute the crc stamp; False means a torn/tampered dump."""
+    stamp = payload.get("crc32")
+    if stamp is None:
+        return False
+    return int(stamp) == blackbox_crc(payload)
+
+
+def load_blackbox(path: str) -> Tuple[Optional[Dict[str, Any]], str]:
+    """``(payload_or_None, status)`` — status is "ok", "crc_mismatch",
+    or the parse error. A torn dump still returns its parseable payload
+    (flagged) because partial evidence beats none on the crash path."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except Exception as e:
+        return None, f"unreadable: {type(e).__name__}: {e}"
+    if payload.get("schema") != BLACKBOX_SCHEMA:
+        return payload, f"unknown schema {payload.get('schema')!r}"
+    return payload, "ok" if verify_blackbox(payload) else "crc_mismatch"
+
+
+def _rank_summary(payload: Dict[str, Any], status: str) -> Dict[str, Any]:
+    steps = payload.get("steps") or []
+    last = steps[-1] if steps else {}
+    out = {
+        "status": status,
+        "reason": payload.get("reason"),
+        "exit_code": payload.get("exit_code"),
+        "ts": payload.get("ts"),
+        "host": payload.get("host"),
+        "pid": payload.get("pid"),
+        "steps_recorded": len(steps),
+        "last_step": last.get("step"),
+        "last_loss": last.get("loss"),
+        "last_grad_norm": last.get("grad_norm"),
+        "event_counts": payload.get("event_counts") or {},
+    }
+    exc = payload.get("exception")
+    if exc:
+        out["exception"] = {"type": exc.get("type"),
+                            "message": exc.get("message")}
+    return out
+
+
+def sweep_blackbox_dumps(telemetry_dir: str,
+                         out_path: Optional[str] = None,
+                         event_tail: int = 80
+                         ) -> Optional[Dict[str, Any]]:
+    """Merge every ``blackbox-rank*.json`` under ``telemetry_dir`` into
+    one run-level ``crash-report.json`` (atomic write).
+
+    Returns the report dict, or None when no dumps exist (a clean exit
+    leaves no blackbox — sweeping is safe to call unconditionally).
+    """
+    paths = sorted(glob.glob(os.path.join(telemetry_dir,
+                                          "blackbox-rank*.json")))
+    if not paths:
+        return None
+    ranks: Dict[str, Dict[str, Any]] = {}
+    merged_events: List[Dict[str, Any]] = []
+    reasons: Dict[str, int] = {}
+    exit_codes: Dict[str, int] = {}
+    for path in paths:
+        m = _RANK_RE.search(os.path.basename(path))
+        rank = m.group(1) if m else os.path.basename(path)
+        payload, status = load_blackbox(path)
+        if payload is None:
+            ranks[rank] = {"status": status, "path": path}
+            continue
+        summary = _rank_summary(payload, status)
+        summary["path"] = path
+        ranks[rank] = summary
+        reason = str(payload.get("reason"))
+        reasons[reason] = reasons.get(reason, 0) + 1
+        code = str(payload.get("exit_code"))
+        exit_codes[code] = exit_codes.get(code, 0) + 1
+        for ev in (payload.get("events") or []):
+            ev = dict(ev)
+            ev.setdefault("rank", payload.get("rank"))
+            merged_events.append(ev)
+    merged_events.sort(key=lambda e: e.get("ts", 0.0))
+    last_steps = [r.get("last_step") for r in ranks.values()
+                  if r.get("last_step") is not None]
+    # the first rank to die (earliest dump ts) usually holds the root
+    # cause; straggler ranks die later of collective timeouts
+    first_rank = None
+    first_ts = None
+    for rank, r in ranks.items():
+        ts = r.get("ts")
+        if ts is not None and (first_ts is None or ts < first_ts):
+            first_ts, first_rank = ts, rank
+    report = {
+        "schema": CRASH_REPORT_SCHEMA,
+        "generated_ts": time.time(),
+        "telemetry_dir": os.path.abspath(telemetry_dir),
+        "num_ranks": len(ranks),
+        "reasons": reasons,
+        "exit_codes": exit_codes,
+        "first_fatal_rank": first_rank,
+        "last_step_min": min(last_steps) if last_steps else None,
+        "last_step_max": max(last_steps) if last_steps else None,
+        "ranks": ranks,
+        "events_tail": merged_events[-event_tail:],
+    }
+    out_path = out_path or os.path.join(telemetry_dir, "crash-report.json")
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    os.replace(tmp, out_path)
+    report["path"] = out_path
+    return report
