@@ -23,9 +23,12 @@
 #   make memreport  analytic HBM report for the 1.3B seq-1024 train step
 #                from avals-only AOT compile (docs/performance.md
 #                "The 1.3B memory ceiling")
-#   make serve-bench  continuous-batching vs sequential serving latency
-#                (TTFT / per-token / aggregate tok/s, CPU backend,
-#                commits benchmarks/inference/serving_bench_results.json)
+#   make serve-bench  serving front door under the bursty prefix-skewed
+#                trace: CB+prefix-cache vs cold CB vs sequential (TTFT /
+#                tok/s / hit rate, CPU backend, commits benchmarks/
+#                inference/serving_bench_prefix_results.json)
+#   make serve-bench-uniform  the original uniform-trace CB-vs-sequential
+#                comparison (serving_bench_results.json)
 #   make data-bench  packed input pipeline: dataloader+h2d phase share
 #                with background prefetch off vs on (commits
 #                benchmarks/data/input_pipeline_bench_results.json)
@@ -41,7 +44,7 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/inference/engine.py
 
 .PHONY: quick test smoke chaos profile blackbox memreport check hooks \
-        hot-changed serve-bench data-bench
+        hot-changed serve-bench serve-bench-uniform data-bench
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
 # process-group units, with tests marked `slow` (pyproject marker) opted
@@ -54,6 +57,7 @@ quick:
 	  tests/unit/test_grad_exchange_modes.py \
 	  tests/unit/test_flash_autotune.py tests/unit/test_procgroup.py \
 	  tests/unit/test_launcher.py tests/unit/test_serving.py \
+	  tests/unit/test_serving_frontdoor.py \
 	  tests/unit/test_data_pipeline.py tests/unit/test_telemetry.py \
 	  -q -x -m "not slow"
 
@@ -76,12 +80,17 @@ memreport:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/memory_report.py \
 	  --out benchmarks/memory_report_1p3b.json
 
-# continuous batching vs sequential generate: TTFT / per-token latency /
-# aggregate tokens/sec over >=16 concurrent streaming sequences at window
-# 512 (docs/performance.md "Serving"). Runs on the virtual CPU backend;
-# writes benchmarks/inference/serving_bench_results.json (a backend/mode
-# failure still writes a partial-result JSON and exits nonzero).
+# the serving front-door headline: bursty prefix-skewed trace through
+# CB+prefix-cache vs cold CB vs sequential generate (docs/performance.md
+# "Serving"). Runs on the virtual CPU backend; writes benchmarks/
+# inference/serving_bench_prefix_results.json and exits nonzero unless
+# prefix p95 TTFT strictly beats cold CB with a positive hit rate.
 serve-bench:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/inference/serving_prefix_bench.py
+
+# the original uniform-trace comparison (CB vs sequential, no prefix
+# reuse); writes benchmarks/inference/serving_bench_results.json.
+serve-bench-uniform:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/inference/serving_bench.py
 
 # packed input pipeline: dataloader+h2d share of step time with

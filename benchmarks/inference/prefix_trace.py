@@ -1,0 +1,81 @@
+"""Bursty, prefix-skewed request trace for the serving front door.
+
+Production traffic is not the uniform ragged set the original
+serving_bench used: a handful of system prompts dominate (one per
+tenant/product surface), arrivals come in bursts of the same surface,
+and only the user turn varies. This generator makes that shape
+deterministic and bench-friendly:
+
+* ``num_prefixes`` shared prefixes with zipf-ish popularity weights,
+  lengths in whole layout blocks (a 384-token system prompt is 6 blocks
+  at the default 64);
+* arrivals in bursts: each burst picks one prefix by popularity and
+  emits ``burst_len`` consecutive requests with it;
+* suffix (user-turn) lengths are ``suffix_base + k * block`` — varied,
+  but congruent mod the block, so every request lands at the SAME pad
+  offset once the scheduler left-pads to its prompt bucket. That
+  congruence is what makes cached prefixes reusable: the prefix cache
+  keys on the padded column prefix (positions are baked into cached
+  KV), so requests share an entry iff they agree on tokens AND offset.
+  Real front doors get the same effect by bucketing request lengths —
+  this trace just makes the bucketing explicit.
+
+Used by ``serving_prefix_bench.py`` (the ``make serve-bench``
+headline) and importable from tests.
+"""
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def make_bursty_prefix_trace(
+        num_requests: int,
+        block: int = 64,
+        seed: int = 0,
+        num_prefixes: int = 3,
+        prefix_blocks: Sequence[int] = (6, 4, 2),
+        weights: Sequence[float] = (0.6, 0.3, 0.1),
+        suffix_base: int = 45,
+        suffix_spread: Sequence[int] = (0, 1, 2),
+        burst_len: int = 4,
+        vocab: int = 8192,
+) -> Tuple[List[List[int]], Dict]:
+    """Returns ``(prompts, meta)``; ``meta['prefix_of']`` maps request
+    index -> prefix id (-1 never occurs: every request has a prefix)."""
+    if not (len(prefix_blocks) >= num_prefixes and
+            len(weights) >= num_prefixes):
+        raise ValueError("need a block count and weight per prefix")
+    if not 0 < suffix_base:
+        raise ValueError("suffix_base must be positive")
+    rng = np.random.default_rng(seed)
+    w = np.asarray(weights[:num_prefixes], float)
+    w = w / w.sum()
+    prefixes = [list(rng.integers(1, vocab, size=int(b) * block))
+                for b in prefix_blocks[:num_prefixes]]
+
+    prompts: List[List[int]] = []
+    prefix_of: List[int] = []
+    while len(prompts) < num_requests:
+        pid = int(rng.choice(num_prefixes, p=w))
+        for _ in range(min(burst_len, num_requests - len(prompts))):
+            k = int(rng.choice(list(suffix_spread)))
+            suffix = list(rng.integers(1, vocab,
+                                       size=suffix_base + k * block))
+            prompts.append(prefixes[pid] + suffix)
+            prefix_of.append(pid)
+
+    meta = {
+        "num_prefixes": num_prefixes,
+        "prefix_lens": [len(p) for p in prefixes],
+        "weights": [float(x) for x in w],
+        "burst_len": burst_len,
+        "suffix_base": suffix_base,
+        "block": block,
+        "prefix_of": prefix_of,
+        "prompt_lens": [len(p) for p in prompts],
+        # every length is congruent mod block -> one shared pad offset
+        "pad_offset": (-len(prompts[0])) % block if prompts else 0,
+    }
+    assert len({(-n) % block for n in meta["prompt_lens"]}) <= 1
+    return prompts, meta

@@ -1,9 +1,13 @@
-from deepspeed_tpu.inference.engine import (InferenceEngine, init_inference,
+from deepspeed_tpu.inference.engine import (InferenceEngine,
+                                            continuation_chunk_spans,
+                                            init_inference,
                                             prefill_chunk_spans)
-from deepspeed_tpu.inference.scheduler import (Completion,
+from deepspeed_tpu.inference.scheduler import (AdmissionRejected, Completion,
                                                ContinuousBatchingScheduler,
-                                               Request, ServingStats)
+                                               QueueFullError, Request,
+                                               RequestShedError, ServingStats)
 
 __all__ = ["InferenceEngine", "init_inference", "prefill_chunk_spans",
-           "ContinuousBatchingScheduler", "Request", "Completion",
-           "ServingStats"]
+           "continuation_chunk_spans", "ContinuousBatchingScheduler",
+           "Request", "Completion", "ServingStats", "AdmissionRejected",
+           "QueueFullError", "RequestShedError"]

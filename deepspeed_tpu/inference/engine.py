@@ -87,6 +87,36 @@ def prefill_chunk_spans(model_cfg, T: int):
     return [(s, min(s + blk, T)) for s in range(0, T, blk)]
 
 
+def continuation_chunk_spans(model_cfg, start: int, end: int):
+    """Spans for an EXACT continuation prefill of columns ``[start, end)``
+    on a cache that already holds ``start`` written positions.
+
+    The prefix-cache admission path resumes a chunked prefill mid-prompt
+    (``prefill_chunk_spans`` only covers start-from-0), and ``start`` need
+    NOT be block-aligned: a promotion snapshot can cut anywhere. The same
+    residency argument applies span-by-span: a pass writing positions
+    ``[s, e)`` evicts up to position ``e - ring_len``, while its earliest
+    query needs block ``s//blk - w_blk`` resident — guaranteed iff the
+    span never crosses a layout-block boundary. When ``end <= ring_len``
+    nothing is evicted at all, so one pass is exact regardless of
+    alignment; dense caches are always one pass.
+    """
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
+        ring_engaged
+
+    if not 0 <= start < end:
+        raise ValueError(f"bad continuation span [{start}, {end})")
+    ring = ring_engaged(model_cfg) if model_cfg is not None else None
+    if ring is not None:
+        w_blk, g_tok, blk = ring
+        ring_len = (w_blk + 1) * blk
+        if end > ring_len:
+            return [(s, min(end, (s // blk + 1) * blk))
+                    for s in range(start, end)
+                    if s == start or s % blk == 0]
+    return [(start, end)]
+
+
 def init_inference(model, config: Optional[Dict[str, Any]] = None,
                    mp_size: int = 1, dtype=None, checkpoint: Optional[str] = None,
                    replace_with_kernel_inject: bool = True, seed: int = 0,

@@ -46,8 +46,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.inference.engine import prefill_chunk_spans
+from deepspeed_tpu.inference.engine import (
+    continuation_chunk_spans,
+    prefill_chunk_spans,
+)
 from deepspeed_tpu.parallel.mesh import set_default_topology
+
+
+class AdmissionRejected(RuntimeError):
+    """Base for 429-style rejections; carries the request id (or None when
+    rejected before one was issued) and a machine-readable reason."""
+
+    def __init__(self, message: str, reason: str = "rejected"):
+        super().__init__(message)
+        self.reason = reason
+
+
+class QueueFullError(AdmissionRejected):
+    """submit() hit the scheduler's ``max_pending`` bound."""
+
+    def __init__(self, message: str):
+        super().__init__(message, reason="queue_full")
+
+
+class RequestShedError(AdmissionRejected):
+    """The admission controller shed this request to hold its SLO."""
+
+    def __init__(self, message: str, reason: str = "slo_shed"):
+        super().__init__(message, reason=reason)
 
 
 @dataclass
@@ -137,13 +163,33 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine, slots: int = 8,
                  prompt_bucket: Optional[int] = None,
                  temperature: float = 0.0,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 max_pending: Optional[int] = None,
+                 prefix_cache=None,
+                 admission_controller=None,
+                 reject_callback: Optional[Callable] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         self.engine = engine
         self.slots = int(slots)
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
+        # front-door hooks (serving/ wires these; all duck-typed so the
+        # scheduler keeps zero imports from the serving package):
+        #   max_pending — bound on the submit queue, SLO controller or not
+        #   prefix_cache — serving.PrefixCache (lookup/promotion_target/
+        #       insert/release protocol used in _admit_prefill)
+        #   admission_controller — .decide(queue_depth, slots) ->
+        #       (admit, reason), consulted per submit()
+        #   reject_callback(request_id, reason) — the 429 hook, invoked
+        #       before the typed error is raised
+        self.max_pending = None if max_pending is None else int(max_pending)
+        self.prefix_cache = prefix_cache
+        self.admission_controller = admission_controller
+        self.reject_callback = reject_callback
+        self.shed_count = 0
         self._mcfg = getattr(engine.module, "config", None)
 
         from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
@@ -170,19 +216,37 @@ class ContinuousBatchingScheduler:
         self._pending: deque = deque()
         self._next_id = 0
         self._splice_fn = None
+        self._copy_fn = None
         self._empty_cache_shapes = None
 
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
                stream_callback: Optional[Callable] = None) -> int:
-        """Queue one request; returns its request id."""
+        """Queue one request; returns its request id.
+
+        Raises ``QueueFullError`` when the queue is at ``max_pending`` and
+        ``RequestShedError`` when the admission controller sheds — both
+        AdmissionRejected, the 429 surface. The reject callback fires
+        first, so a server can answer the client before the raise unwinds.
+        """
         prompt = list(int(t) for t in prompt)
         if not prompt:
             raise ValueError("an empty prompt cannot seed generation")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        depth = len(self._pending)
+        if self.max_pending is not None and depth >= self.max_pending:
+            self._reject(QueueFullError(
+                f"admission queue is full ({depth}/{self.max_pending} "
+                "pending); retry after the scheduler drains"), depth)
+        if self.admission_controller is not None:
+            admit, reason = self.admission_controller.decide(
+                queue_depth=depth, slots=self.slots)
+            if not admit:
+                self._reject(RequestShedError(
+                    f"request shed by admission control: {reason}"), depth)
         bucketed = self._bucketed_len(len(prompt))
         if self._max_pos is not None and not self._streaming and \
                 bucketed + max_new_tokens > self._max_pos:
@@ -198,6 +262,20 @@ class ContinuousBatchingScheduler:
                       stream_callback=stream_callback, request_id=rid)
         self._pending.append((req, time.monotonic()))
         return rid
+
+    def _reject(self, exc: AdmissionRejected, depth: int):
+        """Publish serve.shed, fire the 429 callback, raise ``exc``."""
+        from deepspeed_tpu.telemetry.bus import KIND_SERVE_SHED, publish
+
+        self.shed_count += 1
+        publish(KIND_SERVE_SHED, severity="warning", reason=exc.reason,
+                queue_depth=depth, shed_total=self.shed_count)
+        if self.reject_callback is not None:
+            try:
+                self.reject_callback(None, exc.reason)
+            except Exception:  # the callback must not mask the rejection
+                pass
+        raise exc
 
     def _bucketed_len(self, n: int) -> int:
         b = self.prompt_bucket
@@ -273,6 +351,16 @@ class ContinuousBatchingScheduler:
             self._splice_fn = jax.jit(splice, donate_argnums=(0,))
         return self._splice_fn(cache, sub_cache, jnp.int32(lane))
 
+    def _copy_tree(self, tree):
+        """Jitted deep copy of a cache pytree. Continuation prefill DONATES
+        its cache argument, so both the cached entry handed to a lane and
+        the snapshot taken at a promotion boundary must be fresh buffers —
+        extending a cached tree in place would invalidate the cache."""
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t))
+        return self._copy_fn(tree)
+
     def _admit_prefill(self, req: Request):
         """Exact (chunked when needed) prefill of one prompt on a
         ``[1, Lp]`` batch; returns (first sampled token, sub cache)."""
@@ -282,8 +370,12 @@ class ContinuousBatchingScheduler:
         mask = np.zeros((1, Lp), bool)
         ids[0, Lp - len(req.prompt):] = req.prompt
         mask[0, Lp - len(req.prompt):] = True
-        logits_last, sub_cache = eng._chunked_prefill(
-            jnp.asarray(ids), jnp.asarray(mask))
+        if self.prefix_cache is not None:
+            logits_last, sub_cache = self._prefix_prefill(
+                ids, mask, req.request_id)
+        else:
+            logits_last, sub_cache = eng._chunked_prefill(
+                jnp.asarray(ids), jnp.asarray(mask))
         eng._rng, sub = jax.random.split(eng._rng)
         if self.temperature > 0:
             tok = jax.random.categorical(
@@ -291,6 +383,75 @@ class ContinuousBatchingScheduler:
         else:
             tok = jnp.argmax(logits_last, axis=-1)
         return int(np.asarray(tok)[0]), sub_cache
+
+    def _prefix_prefill(self, ids: np.ndarray, mask: np.ndarray,
+                        request_id):
+        """Admission prefill through the shared-prefix cache.
+
+        The cache key is the PADDED column prefix (pads encoded as -1):
+        decode positions advance for pad columns too and rotary phases are
+        baked into cached keys at write time, so a cached prefix is only
+        numerics-compatible with the cold path at the same padded offset.
+        Two prompts therefore share an entry iff they agree on both the
+        leading tokens AND ``(-len) % prompt_bucket``.
+
+        On a hit: copy the entry's leaves (continuation donates) and resume
+        the chunked prefill from the cached length via
+        ``continuation_chunk_spans`` — spans that never cross a layout
+        block keep every chunk exact, same argument as the cold path. On a
+        promotion (``promotion_target``): prefill ``[0, c)`` cold, snapshot
+        a copy into the cache, continue to ``Lp``. With no hit and no
+        promotion this is byte-for-byte the cold ``_chunked_prefill``.
+        """
+        eng = self.engine
+        pc = self.prefix_cache
+        Lp = ids.shape[1]
+        cols = tuple(int(t) if m else -1
+                     for t, m in zip(ids[0], mask[0]))
+        # limit Lp-1 keeps >= 1 column for the continuation pass, so the
+        # final span always regenerates the last-token logits
+        entry = pc.lookup(cols, limit=Lp - 1, request_id=request_id)
+        start = 0
+        cache = None
+        if entry is not None:
+            start = entry.length
+            cache = self._copy_tree(entry.cache)
+            pc.release(entry)
+        target = pc.promotion_target(cols, limit=Lp - 1, have=start)
+
+        logits_last = None
+        if cache is None:
+            cold_end = target if target is not None else Lp
+            logits_last, cache = eng._chunked_prefill(
+                jnp.asarray(ids[:, :cold_end]),
+                jnp.asarray(mask[:, :cold_end]))
+            start = cold_end
+        if target is not None and target > start:
+            for s, e in continuation_chunk_spans(self._mcfg, start, target):
+                logits_last, cache = eng._prefill_more_fn(
+                    eng._params, jnp.asarray(ids[:, s:e]),
+                    jnp.asarray(mask[:, s:e]), cache)
+            start = target
+        if target is not None:
+            pc.insert(cols[:target], self._copy_tree(cache),
+                      request_id=request_id)
+        if start < Lp:
+            for s, e in continuation_chunk_spans(self._mcfg, start, Lp):
+                logits_last, cache = eng._prefill_more_fn(
+                    eng._params, jnp.asarray(ids[:, s:e]),
+                    jnp.asarray(mask[:, s:e]), cache)
+        return logits_last, cache
+
+    def frontdoor_stats(self) -> Dict[str, Any]:
+        """Shed + prefix-cache counters for benches and servers."""
+        out: Dict[str, Any] = {"shed": self.shed_count,
+                               "pending": len(self._pending)}
+        if self.prefix_cache is not None:
+            out["prefix"] = self.prefix_cache.stats()
+        if self.admission_controller is not None and \
+                hasattr(self.admission_controller, "stats"):
+            out["admission"] = self.admission_controller.stats()
+        return out
 
     # ------------------------------------------------------------------
     def run(self) -> ServingStats:

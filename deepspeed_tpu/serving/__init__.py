@@ -1,0 +1,107 @@
+"""Serving front door: shared-prefix KV cache, SLO admission, routing.
+
+Three layers over ``inference/scheduler.py``'s continuous batching:
+
+* :class:`PrefixCache` — prefill a popular prompt prefix once, splice
+  its KV leaves into every admitted lane that shares it (exact: keys
+  are padded column prefixes, continuations never cross a ring block);
+* :class:`SLOAdmissionController` — telemetry-bus-driven load shedding
+  that holds a p95 TTFT SLO with a bounded queue;
+* :class:`PrefixRouter` — hash-affine, depth-balanced placement across
+  replicas (``examples/serve_router.py`` runs it for real).
+
+``build_serving`` is the config-plumbing entry point — the serving
+analogue of ``deepspeed_tpu.initialize(config=...)``.
+"""
+
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.inference.scheduler import (
+    AdmissionRejected,
+    ContinuousBatchingScheduler,
+    QueueFullError,
+    RequestShedError,
+)
+from deepspeed_tpu.serving.admission import (
+    AdmissionConfig,
+    SLOAdmissionController,
+)
+from deepspeed_tpu.serving.prefix_cache import (
+    PrefixCache,
+    PrefixCacheConfig,
+)
+from deepspeed_tpu.serving.router import PrefixRouter, route_trace
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionRejected",
+    "ContinuousBatchingScheduler",
+    "PrefixCache",
+    "PrefixCacheConfig",
+    "PrefixRouter",
+    "QueueFullError",
+    "RequestShedError",
+    "SLOAdmissionController",
+    "build_serving",
+    "route_trace",
+]
+
+
+def _default_align(engine, prompt_bucket: Optional[int]) -> int:
+    """Ring layout block when the model rings, else the prompt bucket —
+    the boundaries admission prefill naturally produces."""
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
+        ring_engaged
+
+    mcfg = getattr(engine.module, "config", None)
+    ring = ring_engaged(mcfg) if mcfg is not None else None
+    if ring is not None:
+        return ring[2]
+    return prompt_bucket if prompt_bucket else 64
+
+
+def build_serving(engine, config: Optional[Dict[str, Any]] = None,
+                  reject_callback=None) -> ContinuousBatchingScheduler:
+    """Assemble the front door from one config dict::
+
+        build_serving(engine, {
+            "slots": 8,
+            "prompt_bucket": 64,
+            "temperature": 0.0,
+            "max_pending": 256,
+            "prefix_cache": {"promote_after": 2,
+                             "budget_bytes": 512 << 20},
+            "admission": {"slo_ttft_p95_s": 2.0, "window": 64},
+        })
+
+    ``prefix_cache``/``admission`` accept a knob dict, ``True`` (all
+    defaults), or ``False``/absent (off). Unknown keys raise — a typo'd
+    knob silently running with defaults is how SLOs get missed.
+    """
+    cfg = dict(config or {})
+    slots = int(cfg.pop("slots", 8))
+    prompt_bucket = cfg.pop("prompt_bucket", None)
+    temperature = float(cfg.pop("temperature", 0.0))
+    eos_token_id = cfg.pop("eos_token_id", None)
+    max_pending = cfg.pop("max_pending", None)
+    pc_cfg = cfg.pop("prefix_cache", False)
+    adm_cfg = cfg.pop("admission", False)
+    if cfg:
+        raise ValueError(f"unknown serving config keys: {sorted(cfg)}")
+
+    prefix_cache = None
+    if pc_cfg:
+        knobs = dict(pc_cfg) if isinstance(pc_cfg, dict) else {}
+        knobs.setdefault("align", _default_align(engine, prompt_bucket))
+        prefix_cache = PrefixCache(PrefixCacheConfig(**knobs))
+
+    admission = None
+    if adm_cfg:
+        knobs = dict(adm_cfg) if isinstance(adm_cfg, dict) else {}
+        admission = SLOAdmissionController(AdmissionConfig(**knobs))
+
+    return ContinuousBatchingScheduler(
+        engine, slots=slots, prompt_bucket=prompt_bucket,
+        temperature=temperature, eos_token_id=eos_token_id,
+        max_pending=max_pending, prefix_cache=prefix_cache,
+        admission_controller=admission, reject_callback=reject_callback)

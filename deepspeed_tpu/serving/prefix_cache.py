@@ -1,0 +1,241 @@
+"""Shared-prefix KV cache for the continuous-batching front door.
+
+Production traffic is prefix-skewed: millions of requests share a system
+prompt, and re-prefilling it per admission is the single biggest TTFT
+lever (ROADMAP item 2; the Gemma-on-TPU serving writeup makes the same
+point). This module caches the KV leaves of popular prompt prefixes so
+the scheduler's admission prefill can resume mid-prompt instead of
+starting cold.
+
+Exactness is the whole game, and it pins the design:
+
+* **Keys are PADDED column prefixes** (pads encoded as -1). The decode
+  cache advances its position clock for pad columns too, and rotary
+  phases are baked into cached keys at write time — so a prefix
+  prefilled at pad offset 2 is NOT numerics-compatible with the same
+  tokens at offset 5. Two prompts share an entry iff they agree on the
+  leading padded columns, i.e. on the tokens AND on
+  ``(-len) % prompt_bucket``. Bucketing quantizes offsets, so real
+  traffic collides often; the bursty bench trace shows the effect.
+* **Entries hold ``[1, ...]``-lane cache trees** exactly as the
+  scheduler's admission prefill produces them; the scheduler copies on
+  hit (continuation prefill donates its cache buffers) and splices the
+  extended tree into a lane via the existing jitted ``_splice``.
+* **Promotion is popularity-driven**: every admission bumps a counter
+  per aligned candidate prefix of its padded prompt; the longest
+  candidate reaching ``promote_after`` is snapshotted during that very
+  admission (the prefill was running anyway, so materialization costs
+  one jitted copy, not an extra forward).
+
+Eviction is LRU over a byte budget derived from
+``telemetry/memory.py``'s HBM accounting (explicit bytes win; a
+fraction of detected HBM otherwise; a small fallback on backends with
+no HBM figure, e.g. the CPU test mesh). Entries whose leaves are
+currently being copied into a lane hold a refcount and are never
+evicted mid-use.
+
+Like the scheduler it feeds, this class is single-threaded by design —
+one serving loop owns it. The router scales out with one cache per
+replica process, not a shared one.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from collections import OrderedDict
+
+from deepspeed_tpu.telemetry.bus import (
+    KIND_SERVE_PREFIX_EVICT,
+    KIND_SERVE_PREFIX_HIT,
+    KIND_SERVE_PREFIX_MISS,
+    publish,
+)
+
+Key = Tuple[int, ...]
+
+
+@dataclass
+class PrefixCacheConfig:
+    """Knobs for detection and retention.
+
+    ``align`` sets the candidate prefix boundaries (every multiple of it
+    is a potential cut). Any value is EXACT — continuation spans never
+    cross a ring block regardless of where the snapshot cut — so this is
+    purely a detection-granularity/memory knob; the natural choice is
+    the ring layout block (or the prompt bucket for dense models), which
+    ``serving.build_serving`` wires automatically.
+    """
+    align: int = 64
+    promote_after: int = 2          # admissions sharing a prefix before
+                                    # its KV is materialized
+    min_prefix_tokens: int = 1      # REAL (non-pad) tokens a candidate
+                                    # must contain
+    budget_bytes: Optional[int] = None   # explicit cap wins over frac
+    budget_frac_hbm: float = 0.05        # share of detected device HBM
+    fallback_budget_bytes: int = 256 << 20  # no-HBM backends (CPU mesh)
+    counter_capacity: int = 4096    # popularity counters kept (LRU)
+
+    def __post_init__(self):
+        if self.align < 1:
+            raise ValueError(f"align must be >= 1, got {self.align}")
+        if self.promote_after < 1:
+            raise ValueError(
+                f"promote_after must be >= 1, got {self.promote_after}")
+
+
+class _Entry:
+    __slots__ = ("key", "length", "cache", "nbytes", "refs")
+
+    def __init__(self, key: Key, cache, nbytes: int):
+        self.key = key
+        self.length = len(key)
+        self.cache = cache
+        self.nbytes = int(nbytes)
+        self.refs = 0
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for leaf in jax.tree.leaves(tree))
+
+
+class PrefixCache:
+    """Ref-counted LRU cache of prefilled prompt-prefix KV trees."""
+
+    def __init__(self, config: Optional[PrefixCacheConfig] = None,
+                 device=None):
+        self.config = config or PrefixCacheConfig()
+        self.budget_bytes = self._resolve_budget(device)
+        self._entries: "OrderedDict[Key, _Entry]" = OrderedDict()
+        self._counts: "OrderedDict[Key, int]" = OrderedDict()
+        self.bytes_used = 0
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+        self.insert_skips = 0
+
+    def _resolve_budget(self, device) -> int:
+        cfg = self.config
+        if cfg.budget_bytes is not None:
+            return int(cfg.budget_bytes)
+        from deepspeed_tpu.telemetry.memory import hbm_bytes
+
+        total, _source = hbm_bytes(device)
+        if total is None:
+            return int(cfg.fallback_budget_bytes)
+        return int(total * cfg.budget_frac_hbm)
+
+    # -- candidate geometry -------------------------------------------
+    def _pad_offset(self, cols: Key) -> int:
+        o = 0
+        for c in cols:
+            if c >= 0:
+                break
+            o += 1
+        return o
+
+    def _candidate_lengths(self, cols: Key, limit: int):
+        """Aligned cut lengths (ascending) eligible as cache keys: every
+        multiple of ``align`` up to ``limit`` that leaves at least one
+        trailing column AND contains >= min_prefix_tokens real tokens."""
+        cfg = self.config
+        off = self._pad_offset(cols)
+        lo = off + cfg.min_prefix_tokens
+        a = cfg.align
+        first = ((max(lo, a) + a - 1) // a) * a
+        return list(range(first, limit + 1, a))
+
+    # -- the scheduler-facing protocol --------------------------------
+    def lookup(self, cols: Key, limit: int,
+               request_id=None) -> Optional[_Entry]:
+        """Longest cached prefix of ``cols[:limit]``, or None. A returned
+        entry is pinned (refs+1) — ``release()`` it once its leaves have
+        been copied out."""
+        for length in reversed(self._candidate_lengths(cols, limit)):
+            entry = self._entries.get(cols[:length])
+            if entry is not None:
+                entry.refs += 1
+                self._entries.move_to_end(entry.key)
+                self.hits += 1
+                publish(KIND_SERVE_PREFIX_HIT, request_id=request_id,
+                        prefix_len=entry.length, nbytes=entry.nbytes)
+                return entry
+        self.misses += 1
+        publish(KIND_SERVE_PREFIX_MISS, request_id=request_id,
+                prompt_cols=len(cols))
+        return None
+
+    def release(self, entry: _Entry) -> None:
+        entry.refs = max(0, entry.refs - 1)
+
+    def promotion_target(self, cols: Key, limit: int,
+                         have: int = 0) -> Optional[int]:
+        """Bump popularity for every candidate prefix of this prompt;
+        return the longest length past ``have`` whose count has reached
+        ``promote_after`` and which is not already cached — the caller
+        snapshots its cache there during the admission prefill."""
+        cfg = self.config
+        best = None
+        for length in self._candidate_lengths(cols, limit):
+            key = cols[:length]
+            n = self._counts.pop(key, 0) + 1
+            self._counts[key] = n  # pop+set keeps LRU order fresh
+            if (n >= cfg.promote_after and length > have
+                    and key not in self._entries):
+                best = length
+        while len(self._counts) > cfg.counter_capacity:
+            self._counts.popitem(last=False)
+        return best
+
+    def insert(self, key: Key, cache, request_id=None) -> bool:
+        """Adopt a prefilled cache tree for ``key``; evicts LRU unpinned
+        entries to fit the byte budget. Returns False (and drops the
+        tree) when the entry cannot fit — every survivor is pinned or
+        the tree alone exceeds the budget."""
+        if key in self._entries:
+            self.insert_skips += 1
+            return False
+        nbytes = _tree_nbytes(cache)
+        if not self._make_room(nbytes):
+            self.insert_skips += 1
+            return False
+        self._entries[key] = _Entry(key, cache, nbytes)
+        self.bytes_used += nbytes
+        self.insertions += 1
+        return True
+
+    def _make_room(self, need: int) -> bool:
+        if need > self.budget_bytes:
+            return False
+        while self.bytes_used + need > self.budget_bytes:
+            victim = next((e for e in self._entries.values()
+                           if e.refs == 0), None)
+            if victim is None:
+                return False  # everything left is mid-splice
+            del self._entries[victim.key]
+            self.bytes_used -= victim.nbytes
+            self.evictions += 1
+            publish(KIND_SERVE_PREFIX_EVICT, prefix_len=victim.length,
+                    nbytes=victim.nbytes, bytes_used=self.bytes_used)
+        return True
+
+    # -- introspection ------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        total = self.hits + self.misses
+        return {
+            "entries": len(self._entries),
+            "bytes_used": self.bytes_used,
+            "budget_bytes": self.budget_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": (self.hits / total) if total else 0.0,
+            "insertions": self.insertions,
+            "insert_skips": self.insert_skips,
+            "evictions": self.evictions,
+        }
+
+    def __len__(self) -> int:
+        return len(self._entries)

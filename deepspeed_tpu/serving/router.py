@@ -1,0 +1,75 @@
+"""Prefix-affine, depth-balanced routing across scheduler replicas.
+
+One scheduler replica holds one prefix cache, so WHERE a request lands
+decides whether its shared prefix is warm: hashing by the prompt's
+leading block sends all requests of one tenant/system-prompt to the
+same replica (cache affinity), while pure hashing lets a hot prefix
+overload its home replica. ``PrefixRouter`` does the standard
+compromise — hash-affine with bounded spill: the hashed home replica
+wins unless its reported queue depth exceeds the cluster minimum by
+more than ``spill_slack``, in which case the request goes to the
+shallowest queue (losing the warm prefix but bounding tail latency).
+
+The router is process-topology-agnostic: it sees only prompts and a
+depth vector. ``examples/serve_router.py`` drives real scheduler
+replicas in separate processes over pipes; unit tests drive it with
+synthetic depths.
+"""
+
+import zlib
+from typing import List, Sequence, Tuple
+
+
+class PrefixRouter:
+    def __init__(self, n_replicas: int, align: int = 64,
+                 spill_slack: int = 2):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if spill_slack < 0:
+            raise ValueError(f"spill_slack must be >= 0, got {spill_slack}")
+        self.n_replicas = int(n_replicas)
+        self.align = int(align)
+        self.spill_slack = int(spill_slack)
+        self.spills = 0
+        self.affine = 0
+
+    def home(self, prompt: Sequence[int]) -> int:
+        """The hash-affine replica for this prompt's leading block."""
+        head = tuple(int(t) for t in prompt[:self.align])
+        digest = zlib.crc32(repr(head).encode())
+        return digest % self.n_replicas
+
+    def route(self, prompt: Sequence[int],
+              depths: Sequence[int]) -> Tuple[int, str]:
+        """(replica index, 'affine'|'spill') given reported queue depths."""
+        if len(depths) != self.n_replicas:
+            raise ValueError(
+                f"got {len(depths)} depths for {self.n_replicas} replicas")
+        pref = self.home(prompt)
+        floor = min(depths)
+        if depths[pref] <= floor + self.spill_slack:
+            self.affine += 1
+            return pref, "affine"
+        self.spills += 1
+        # ties break to the lowest index — deterministic for tests
+        return min(range(self.n_replicas),
+                   key=lambda i: (depths[i], i)), "spill"
+
+    def stats(self) -> dict:
+        total = self.affine + self.spills
+        return {"affine": self.affine, "spills": self.spills,
+                "spill_rate": (self.spills / total) if total else 0.0}
+
+
+def route_trace(router: PrefixRouter, prompts: List[Sequence[int]],
+                costs: Sequence[int] = None) -> List[int]:
+    """Assign a whole trace against simulated depths (each routed request
+    deepens its replica by its cost; default 1). Used by the bench to
+    report affinity/spill rates without spawning processes."""
+    depths = [0] * router.n_replicas
+    out = []
+    for i, p in enumerate(prompts):
+        r, _ = router.route(p, depths)
+        depths[r] += 1 if costs is None else int(costs[i])
+        out.append(r)
+    return out

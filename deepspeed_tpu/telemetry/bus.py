@@ -42,6 +42,10 @@ KIND_PREFETCH_STARVED = "data.prefetch_starved"
 KIND_SERVE_ADMIT = "serve.admit"
 KIND_SERVE_EVICT = "serve.evict"
 KIND_SERVE_FIRST_TOKEN = "serve.first_token"
+KIND_SERVE_PREFIX_HIT = "serve.prefix_hit"
+KIND_SERVE_PREFIX_MISS = "serve.prefix_miss"
+KIND_SERVE_PREFIX_EVICT = "serve.prefix_evict"
+KIND_SERVE_SHED = "serve.shed"
 KIND_SHUTDOWN = "shutdown.graceful"
 
 

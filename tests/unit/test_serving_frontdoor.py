@@ -1,0 +1,526 @@
+"""Serving front-door tests: shared-prefix KV cache exactness, SLO
+admission control, bounded queues, and the prefix router.
+
+The fast half exercises the policy layer with fake cache trees and
+synthetic telemetry (no compiles); the ``slow``-marked half proves the
+exactness contract on real models — prefix-spliced decode must be
+token-identical to cold-prefill decode on both the ring and dense cache
+branches, and a mid-prompt continuation must match the training forward
+at every position.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.engine import (InferenceEngine,
+                                            continuation_chunk_spans)
+from deepspeed_tpu.inference.scheduler import (ContinuousBatchingScheduler,
+                                               QueueFullError,
+                                               RequestShedError)
+from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
+    apply_sparse_attention
+from deepspeed_tpu.serving import (AdmissionConfig, PrefixCache,
+                                   PrefixCacheConfig, PrefixRouter,
+                                   SLOAdmissionController, build_serving,
+                                   route_trace)
+from deepspeed_tpu.telemetry.bus import (KIND_PREFETCH_STARVED,
+                                         KIND_SERVE_FIRST_TOKEN,
+                                         KIND_SERVE_PREFIX_EVICT,
+                                         KIND_SERVE_PREFIX_HIT,
+                                         KIND_SERVE_PREFIX_MISS,
+                                         KIND_SERVE_SHED, TelemetryBus,
+                                         telemetry_bus)
+
+# block 16, nswb 3 -> w_blk 1, ring = 32 slots (same as test_serving.py)
+_WINDOW = {"mode": "local_sliding_window", "block": 16,
+           "num_sliding_window_blocks": 3}
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+                n_head=4, dtype=jnp.float32, scan_layers=True)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _ring_model(**kw):
+    return apply_sparse_attention(GPT(_cfg(**kw)), _WINDOW)
+
+
+def _fake_tree(nbytes):
+    return {"k": np.zeros(nbytes // 4, np.float32)}
+
+
+def _cols(pads, tokens):
+    return tuple([-1] * pads + list(tokens))
+
+
+class _BusTap:
+    """Collects global-bus events for the duration of a test."""
+
+    def __init__(self, *kinds):
+        self.kinds = set(kinds)
+        self.events = []
+
+    def __enter__(self):
+        def tap(ev):
+            if ev["kind"] in self.kinds:
+                self.events.append(ev)
+
+        self._tap = tap
+        telemetry_bus.subscribe(tap)
+        return self
+
+    def __exit__(self, *exc):
+        telemetry_bus.unsubscribe(self._tap)
+
+
+# ---------------------------------------------------------------------
+class TestContinuationSpans:
+    def test_dense_is_single_pass(self):
+        assert continuation_chunk_spans(_cfg(), 37, 96) == [(37, 96)]
+
+    def test_within_ring_is_single_pass(self):
+        cfg = _ring_model().config
+        # end <= ring_len (32): nothing is evicted, alignment irrelevant
+        assert continuation_chunk_spans(cfg, 5, 32) == [(5, 32)]
+
+    def test_past_ring_never_crosses_a_block(self):
+        cfg = _ring_model().config
+        spans = continuation_chunk_spans(cfg, 37, 96)
+        assert spans[0] == (37, 48)  # unaligned head clipped to boundary
+        assert spans[-1][1] == 96
+        assert all(e - s <= 16 for s, e in spans)
+        assert all((s // 16) == ((e - 1) // 16) for s, e in spans)
+        assert [s for s, _ in spans[1:]] == [e for _, e in spans[:-1]]
+
+    def test_rejects_bad_spans(self):
+        with pytest.raises(ValueError):
+            continuation_chunk_spans(_cfg(), 5, 5)
+
+
+# ---------------------------------------------------------------------
+class TestPrefixCacheUnit:
+    def _pc(self, **kw):
+        kw.setdefault("align", 16)
+        kw.setdefault("budget_bytes", 1 << 20)
+        return PrefixCache(PrefixCacheConfig(**kw))
+
+    def test_candidates_respect_pads_align_and_limit(self):
+        pc = self._pc()
+        cols = _cols(3, range(60))
+        # first multiple of 16 containing >= 1 real token past 3 pads
+        assert pc._candidate_lengths(cols, limit=62) == [16, 32, 48]
+        # min real tokens pushes the first boundary out
+        pc2 = self._pc(min_prefix_tokens=20)
+        assert pc2._candidate_lengths(cols, limit=62) == [32, 48]
+        assert pc._candidate_lengths(cols, limit=15) == []
+
+    def test_lookup_returns_longest_and_pins(self):
+        pc = self._pc()
+        cols = _cols(0, range(100))
+        pc.insert(cols[:16], _fake_tree(1024))
+        pc.insert(cols[:48], _fake_tree(1024))
+        with _BusTap(KIND_SERVE_PREFIX_HIT, KIND_SERVE_PREFIX_MISS) as tap:
+            e = pc.lookup(cols, limit=99, request_id=7)
+            assert e is not None and e.length == 48 and e.refs == 1
+            pc.release(e)
+            assert e.refs == 0
+            assert pc.lookup(_cols(0, range(1, 50)), limit=40) is None
+        assert [ev["kind"] for ev in tap.events] == [
+            KIND_SERVE_PREFIX_HIT, KIND_SERVE_PREFIX_MISS]
+        assert tap.events[0]["prefix_len"] == 48
+        assert pc.stats()["hits"] == 1 and pc.stats()["misses"] == 1
+
+    def test_promotion_waits_for_popularity(self):
+        pc = self._pc(promote_after=2)
+        cols = _cols(0, range(64))
+        assert pc.promotion_target(cols, limit=63) is None  # 1st sighting
+        t = pc.promotion_target(cols, limit=63)  # 2nd: longest candidate
+        assert t == 48
+        pc.insert(cols[:48], _fake_tree(256))
+        # already cached -> no re-promotion at 48; nothing longer fits
+        assert pc.promotion_target(cols, limit=63, have=48) is None
+
+    def test_promotion_detects_shared_boundary(self):
+        """Two prompts sharing 32 columns promote AT 32, not at their
+        private longer boundaries."""
+        pc = self._pc(promote_after=2)
+        a = _cols(0, list(range(32)) + [100] * 32)
+        b = _cols(0, list(range(32)) + [101] * 32)
+        assert pc.promotion_target(a, limit=63) is None
+        assert pc.promotion_target(b, limit=63) == 32
+
+    def test_lru_eviction_respects_pins_and_budget(self):
+        pc = self._pc(budget_bytes=3000)
+        k1, k2 = _cols(0, range(16)), _cols(0, range(100, 116))
+        assert pc.insert(k1, _fake_tree(1024))
+        assert pc.insert(k2, _fake_tree(1024))
+        e1 = pc.lookup(_cols(0, range(32)), limit=31)  # pins + freshens k1
+        assert e1.key == k1
+        with _BusTap(KIND_SERVE_PREFIX_EVICT) as tap:
+            # needs 2048: must evict BOTH residents to fit, but k1 is
+            # pinned -> only k2 (the LRU unpinned) can go -> insert fails
+            assert not pc.insert(_cols(0, range(200, 216)),
+                                 _fake_tree(2048))
+            pc.release(e1)
+            assert pc.insert(_cols(0, range(200, 216)), _fake_tree(2048))
+        assert k1 not in pc._entries  # released pin made it evictable
+        assert pc.bytes_used <= pc.budget_bytes
+        assert len(tap.events) >= 1
+        assert pc.stats()["evictions"] >= 1
+
+    def test_oversized_insert_is_dropped(self):
+        pc = self._pc(budget_bytes=512)
+        assert not pc.insert(_cols(0, range(16)), _fake_tree(1024))
+        assert pc.stats()["insert_skips"] == 1 and len(pc) == 0
+
+    def test_counter_capacity_is_bounded(self):
+        pc = self._pc(counter_capacity=8)
+        for i in range(40):
+            pc.promotion_target(_cols(0, range(i, i + 32)), limit=31)
+        assert len(pc._counts) <= 8
+
+
+# ---------------------------------------------------------------------
+class TestAdmissionController:
+    def _ctl(self, bus=None, clock=None, **kw):
+        kw.setdefault("slo_ttft_p95_s", 1.0)
+        kw.setdefault("window", 16)
+        kw.setdefault("min_samples", 4)
+        return SLOAdmissionController(
+            AdmissionConfig(**kw), bus=bus or TelemetryBus(),
+            clock=clock or (lambda: 0.0))
+
+    def _feed(self, ctl, ttfts):
+        for t in ttfts:
+            ctl.on_event({"kind": KIND_SERVE_FIRST_TOKEN, "ttft_s": t})
+
+    def test_admits_until_p95_breaches_under_load(self):
+        ctl = self._ctl()
+        assert ctl.decide(queue_depth=50, slots=4) == (True, "ok")
+        self._feed(ctl, [5.0] * 8)
+        ok, reason = ctl.decide(queue_depth=50, slots=4)
+        assert not ok and "slo" in reason
+
+    def test_breach_without_backlog_still_admits(self):
+        # shedding with an empty queue would only waste idle capacity
+        ctl = self._ctl()
+        self._feed(ctl, [5.0] * 8)
+        assert ctl.decide(queue_depth=0, slots=4)[0]
+
+    def test_hysteresis_requires_drain_and_recovery(self):
+        ctl = self._ctl()
+        self._feed(ctl, [5.0] * 8)
+        assert not ctl.decide(queue_depth=50, slots=4)[0]
+        # TTFT recovered but queue still deep -> keep shedding
+        self._feed(ctl, [0.1] * 16)
+        assert not ctl.decide(queue_depth=50, slots=4)[0]
+        # drained AND recovered -> admit again
+        assert ctl.decide(queue_depth=2, slots=4)[0]
+
+    def test_prefetch_starvation_sheds_with_grace(self):
+        now = [0.0]
+        ctl = self._ctl(clock=lambda: now[0], starvation_grace_s=2.0)
+        ctl.on_event({"kind": KIND_PREFETCH_STARVED})
+        assert not ctl.decide(queue_depth=8, slots=4)[0]
+        now[0] = 10.0  # signal aged out; queue drained below slots
+        assert ctl.decide(queue_depth=2, slots=4)[0]
+
+    def test_subscribes_to_bus_events(self):
+        bus = TelemetryBus()
+        ctl = self._ctl(bus=bus)
+        for _ in range(6):
+            bus.publish(KIND_SERVE_FIRST_TOKEN, ttft_s=9.0)
+        assert ctl.p95_ttft() == 9.0
+        ctl.close()
+        bus.publish(KIND_SERVE_FIRST_TOKEN, ttft_s=0.0)
+        assert len(ctl._ttfts) == 6
+        assert ctl.stats()["ttft_samples"] == 6
+
+
+# ---------------------------------------------------------------------
+class TestPrefixRouter:
+    def test_same_prefix_same_replica(self):
+        r = PrefixRouter(4, align=16)
+        shared = list(range(16))
+        a, _ = r.route(shared + [1, 2], [0, 0, 0, 0])
+        b, _ = r.route(shared + [9, 9, 9], [0, 0, 0, 0])
+        assert a == b
+
+    def test_spills_off_overloaded_home(self):
+        r = PrefixRouter(3, align=8, spill_slack=1)
+        p = list(range(8))
+        home = r.home(p)
+        depths = [0, 0, 0]
+        depths[home] = 5
+        got, how = r.route(p, depths)
+        assert got != home and how == "spill"
+        assert r.stats()["spills"] == 1
+
+    def test_trace_routing_balances(self):
+        r = PrefixRouter(2, align=4, spill_slack=0)
+        prompts = [[1, 2, 3, 4, i] for i in range(10)]  # one hot prefix
+        placed = route_trace(r, prompts)
+        # zero slack forces alternation between home and the other replica
+        assert set(placed) == {0, 1}
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            PrefixRouter(0)
+        with pytest.raises(ValueError):
+            PrefixRouter(2).route([1], [0])
+
+
+# ---------------------------------------------------------------------
+class TestBoundedQueue:
+    def _eng(self):
+        return InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+
+    def test_max_pending_rejects_typed(self):
+        rejected = []
+        sched = ContinuousBatchingScheduler(
+            self._eng(), slots=2, prompt_bucket=8, max_pending=2,
+            reject_callback=lambda rid, reason: rejected.append(reason))
+        sched.submit([1, 2, 3])
+        sched.submit([4, 5])
+        with _BusTap(KIND_SERVE_SHED) as tap:
+            with pytest.raises(QueueFullError) as ei:
+                sched.submit([6])
+        assert ei.value.reason == "queue_full"
+        assert rejected == ["queue_full"]
+        assert sched.shed_count == 1
+        assert tap.events[0]["queue_depth"] == 2
+        assert len(sched._pending) == 2  # the rejected one never queued
+
+    def test_controller_shed_raises_typed(self):
+        class AlwaysShed:
+            def decide(self, queue_depth, slots):
+                return False, "synthetic overload"
+
+        sched = ContinuousBatchingScheduler(
+            self._eng(), slots=2, prompt_bucket=8,
+            admission_controller=AlwaysShed())
+        with pytest.raises(RequestShedError) as ei:
+            sched.submit([1, 2, 3])
+        assert ei.value.reason == "slo_shed"
+
+    def test_max_pending_validation(self):
+        with pytest.raises(ValueError):
+            ContinuousBatchingScheduler(self._eng(), max_pending=0)
+
+
+# ---------------------------------------------------------------------
+class TestBuildServing:
+    def test_full_config_assembly(self):
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        sched = build_serving(eng, {
+            "slots": 3, "max_pending": 16,
+            "prefix_cache": {"promote_after": 1,
+                             "budget_bytes": 64 << 20},
+            "admission": {"slo_ttft_p95_s": 3.0},
+        })
+        assert sched.max_pending == 16
+        # align auto-detects the ring layout block
+        assert sched.prefix_cache.config.align == 16
+        assert isinstance(sched.admission_controller,
+                          SLOAdmissionController)
+        sched.admission_controller.close()
+
+    def test_dense_align_falls_back_to_bucket(self):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        sched = build_serving(eng, {"prompt_bucket": 8,
+                                    "prefix_cache": True})
+        assert sched.prefix_cache.config.align == 8
+        assert sched.admission_controller is None
+
+    def test_unknown_key_raises(self):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        with pytest.raises(ValueError, match="unknown serving config"):
+            build_serving(eng, {"slo": 1.0})
+
+
+# ---------------------------------------------------------------------
+class TestDryrunParentBackendFree:
+    def test_parent_spawns_without_touching_jax(self, monkeypatch):
+        """VERDICT item 1a: the parent must reach the child spawn without
+        a jax.devices() probe — a poisoned probe proves it."""
+        import __graft_entry__ as g
+
+        monkeypatch.delenv("_GRAFT_DRYRUN_CHILD", raising=False)
+        monkeypatch.delenv("DS_TPU_DRYRUN_INPROC", raising=False)
+        spawned = []
+        monkeypatch.setattr(g, "_reexec_on_virtual_cpu_mesh",
+                            lambda n: spawned.append(n))
+        monkeypatch.setattr(
+            jax, "devices",
+            lambda *a: (_ for _ in ()).throw(
+                AssertionError("parent touched the backend")))
+        g.dryrun_multichip(99)
+        assert spawned == [99]
+
+    def test_inproc_escape_hatch_validates_devices(self, monkeypatch):
+        import __graft_entry__ as g
+
+        monkeypatch.delenv("_GRAFT_DRYRUN_CHILD", raising=False)
+        monkeypatch.setenv("DS_TPU_DRYRUN_INPROC", "1")
+        with pytest.raises(RuntimeError, match="sees .* devices"):
+            g.dryrun_multichip(10 ** 6)
+
+
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+class TestContinuationParityEveryPosition:
+    """A prefill split at an UNALIGNED point mid-prompt (the promotion
+    snapshot cut) must match the training forward at every position."""
+
+    def _chunked_logits(self, model, ids, cut):
+        @jax.jit
+        def prefill(params, chunk):
+            return model.apply({"params": params}, chunk,
+                               deterministic=True, decode=True,
+                               mutable=["cache"])
+
+        @jax.jit
+        def more(params, cache, chunk):
+            return model.apply({"params": params, "cache": cache}, chunk,
+                               deterministic=True, decode=True,
+                               mutable=["cache"])
+
+        params = model.init(jax.random.PRNGKey(0), ids,
+                            deterministic=True)["params"]
+        T = ids.shape[1]
+        cfg = model.config
+        head = continuation_chunk_spans(cfg, 0, cut)
+        (s0, e0), rest = head[0], head[1:]
+        logits, cache = prefill(params, ids[:, s0:e0])
+        pieces = [logits]
+        for s, e in rest + continuation_chunk_spans(cfg, cut, T):
+            logits, cache = more(params, cache["cache"], ids[:, s:e])
+            pieces.append(logits)
+        full = model.apply({"params": params}, ids, deterministic=True)
+        return jnp.concatenate(pieces, axis=1), full
+
+    def test_ring_unaligned_cut(self):
+        model = _ring_model(rotary=True, learned_positions=False)
+        rng = np.random.RandomState(3)
+        ids = jnp.asarray(rng.randint(0, 128, size=(1, 96)), jnp.int32)
+        chunked, full = self._chunked_logits(model, ids, cut=37)
+        np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                                   atol=2e-4, rtol=1e-3)
+
+    def test_dense_cut(self):
+        model = GPT(_cfg())
+        rng = np.random.RandomState(4)
+        ids = jnp.asarray(rng.randint(0, 128, size=(1, 48)), jnp.int32)
+        chunked, full = self._chunked_logits(model, ids, cut=19)
+        np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                                   atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.slow
+class TestPrefixSplicedDecodeExactness:
+    """The acceptance contract: prefix-spliced decode must be
+    token-identical to cold-prefill decode, on ring and dense."""
+
+    def _solo(self, eng, prompt, max_new, blk=16, min_blocks=3):
+        L = max(min_blocks * blk, ((len(prompt) + blk - 1) // blk) * blk)
+        ids = np.zeros((1, L), np.int32)
+        m = np.zeros((1, L), bool)
+        ids[0, :len(prompt)] = prompt
+        m[0, :len(prompt)] = True
+        out = eng.generate(jnp.asarray(ids), max_new_tokens=max_new,
+                           attention_mask=jnp.asarray(m))
+        return np.asarray(out)[0].tolist()
+
+    def _run(self, eng, prompts, max_new, sched):
+        for p in prompts:
+            sched.submit(p, max_new_tokens=max_new)
+        stats = sched.run()
+        got = {c.request_id: c.tokens for c in stats.completions}
+        return [got[i] for i in range(len(prompts))]
+
+    def test_ring_hits_match_cold_and_solo(self):
+        model = _ring_model(rotary=True, learned_positions=False)
+        eng = InferenceEngine(model, {"dtype": "fp32"}, seed=0)
+        rng = np.random.default_rng(0)
+        prefix = list(rng.integers(1, 128, size=40))
+        # suffix lengths congruent mod the 16-token bucket: identical pad
+        # offsets, so all five prompts share the cached padded prefix
+        prompts = [prefix + list(rng.integers(1, 128, size=n))
+                   for n in (9, 25, 41, 9, 25)]
+        solo = [self._solo(eng, p, 6) for p in prompts]
+
+        warm = build_serving(eng, {
+            "slots": 2, "prefix_cache": {"promote_after": 1}})
+        assert self._run(eng, prompts, 6, warm) == solo
+        st = warm.frontdoor_stats()["prefix"]
+        assert st["insertions"] >= 1 and st["hits"] >= 2
+
+        cold = ContinuousBatchingScheduler(eng, slots=2)
+        assert self._run(eng, prompts, 6, cold) == solo
+
+    def test_ring_long_prompts_past_ring_capacity(self):
+        """Hits on prompts 3x the ring: the continuation path must chunk
+        block-by-block exactly like the cold chunked prefill."""
+        model = _ring_model(rotary=True, learned_positions=False)
+        eng = InferenceEngine(model, {"dtype": "fp32"}, seed=0)
+        rng = np.random.default_rng(1)
+        prefix = list(rng.integers(1, 128, size=64))  # 2x ring alone
+        prompts = [prefix + list(rng.integers(1, 128, size=n))
+                   for n in (30, 14, 30)]
+        solo = [self._solo(eng, p, 5) for p in prompts]
+        # promote_after=2: the SECOND same-prefix admission materializes
+        # at the longest SHARED boundary (a lone admission would promote
+        # its own full prompt, which nothing later shares)
+        warm = build_serving(eng, {
+            "slots": 2, "prefix_cache": {"promote_after": 2}})
+        assert self._run(eng, prompts, 5, warm) == solo
+        assert warm.frontdoor_stats()["prefix"]["hits"] >= 1
+
+    def test_dense_hits_match_cold_and_solo(self):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        rng = np.random.default_rng(2)
+        prefix = list(rng.integers(1, 128, size=20))
+        prompts = [prefix + list(rng.integers(1, 128, size=n))
+                   for n in (1, 9, 17, 1)]
+        solo = [self._solo(eng, p, 6, blk=1, min_blocks=1)
+                for p in prompts]
+        warm = build_serving(eng, {
+            "slots": 2, "prompt_bucket": 8,
+            "prefix_cache": {"promote_after": 1}})
+        assert self._run(eng, prompts, 6, warm) == solo
+        assert warm.frontdoor_stats()["prefix"]["hits"] >= 2
+
+    def test_byte_pressure_evicts_but_stays_exact(self):
+        """A budget that holds ~one entry forces eviction churn between
+        two hot prefixes; in-flight pins hold and decode stays exact."""
+        model = _ring_model(rotary=True, learned_positions=False)
+        eng = InferenceEngine(model, {"dtype": "fp32"}, seed=0)
+        rng = np.random.default_rng(3)
+        p1 = list(rng.integers(1, 128, size=40))
+        p2 = list(rng.integers(1, 128, size=40))
+        prompts = []
+        for _ in range(2):  # alternate prefixes -> LRU churn
+            prompts.append(p1 + list(rng.integers(1, 128, size=9)))
+            prompts.append(p2 + list(rng.integers(1, 128, size=9)))
+        solo = [self._solo(eng, p, 5) for p in prompts]
+
+        # measure one entry's footprint, then budget for ~1.2 of them
+        probe = build_serving(eng, {
+            "slots": 2, "prefix_cache": {"promote_after": 1}})
+        assert self._run(eng, prompts[:1], 5, probe) == solo[:1]
+        one = probe.frontdoor_stats()["prefix"]["bytes_used"]
+        assert one > 0
+
+        tight = build_serving(eng, {
+            "slots": 2,
+            "prefix_cache": {"promote_after": 1,
+                             "budget_bytes": int(one * 1.2)}})
+        assert self._run(eng, prompts, 5, tight) == solo
+        st = tight.frontdoor_stats()["prefix"]
+        assert st["evictions"] >= 1
+        assert st["bytes_used"] <= st["budget_bytes"]
