@@ -32,6 +32,23 @@
 #   make data-bench  packed input pipeline: dataloader+h2d phase share
 #                with background prefetch off vs on (commits
 #                benchmarks/data/input_pipeline_bench_results.json)
+#   make dryrun  the multi-axis mesh gate (__graft_entry__.dryrun_
+#                multichip(8)) with per-phase wall clock; commits
+#                benchmarks/dryrun_phase_times.json and fails if the
+#                total breaches the 5-minute budget
+#   make mfu-search  CPU-safe live step-config search: tiny GPT over the
+#                (remat x micro x flash) grid with a tight HBM override
+#                (prune path exercised for real), winner trained under
+#                the step profiler (docs/performance.md "Step
+#                autotuner"); artifact + trace to /tmp
+#   make mfu-search-full  the committed 1.3B seq-1024 artifact: avals-
+#                only AOT grid vs the TPU v4 HBM ceiling + calibrated
+#                roofline MFU (benchmarks/mfu_search_results.json,
+#                ~5 min of CPU compiles)
+#   make overlap-measured  wall-clock bucketed-vs-monolithic exchange
+#                deltas (benchmarks/communication/
+#                overlap_measured_results.json); nonzero exit when
+#                bucketed-on regresses beyond the measured noise band
 #   make check   test + smoke-if-hot-paths-changed — the full gate
 #   make hooks   install the committed .githooks (pre-push runs
 #                `make quick` + conditional smoke)
@@ -41,10 +58,12 @@ PY ?= python
 # a timing change in any of these shipped unnoticed for a round)
 HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/ops deepspeed_tpu/utils/timer.py \
-             deepspeed_tpu/inference/engine.py
+             deepspeed_tpu/inference/engine.py \
+             deepspeed_tpu/runtime/step_autotune.py
 
 .PHONY: quick test smoke chaos profile blackbox memreport check hooks \
-        hot-changed serve-bench serve-bench-uniform data-bench
+        hot-changed serve-bench serve-bench-uniform data-bench dryrun \
+        mfu-search mfu-search-full overlap-measured
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
 # process-group units, with tests marked `slow` (pyproject marker) opted
@@ -59,6 +78,7 @@ quick:
 	  tests/unit/test_launcher.py tests/unit/test_serving.py \
 	  tests/unit/test_serving_frontdoor.py \
 	  tests/unit/test_data_pipeline.py tests/unit/test_telemetry.py \
+	  tests/unit/test_step_autotune.py \
 	  -q -x -m "not slow"
 
 test:
@@ -79,6 +99,25 @@ blackbox:
 memreport:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/memory_report.py \
 	  --out benchmarks/memory_report_1p3b.json
+
+# multi-axis mesh gate with committed per-phase wall clock; the child
+# writes the artifact, and dryrun_multichip itself fails the run when
+# total exceeds DS_TPU_DRYRUN_TOTAL_BUDGET_S (default 300s)
+dryrun:
+	DS_TPU_DRYRUN_TIMES_OUT=benchmarks/dryrun_phase_times.json \
+	  $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# CPU-safe seconds-scale search (small model, live prune + profiler
+# trace); the committed 1.3B artifact comes from mfu-search-full
+mfu-search:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/mfu_search.py --mode small \
+	  --out /tmp/mfu_search_small.json
+
+mfu-search-full:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/mfu_search.py --mode full
+
+overlap-measured:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/communication/overlap_measured.py
 
 # the serving front-door headline: bursty prefix-skewed trace through
 # CB+prefix-cache vs cold CB vs sequential generate (docs/performance.md

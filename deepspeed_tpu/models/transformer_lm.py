@@ -882,6 +882,20 @@ def _remat_policy(name: str):
             jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "attn_lse"),
         )
+    if name == "save_dots":
+        # checkpoint every dot product (param matmuls AND attention
+        # score/value einsums): the backward never re-runs a matmul, at
+        # the cost of keeping the [T, T] attention dots live on the dense
+        # path — the cheapest-recompute / highest-memory selective point
+        return jax.checkpoint_policies.dots_saveable
+    if name == "save_nothing_but_flash":
+        # keep ONLY the flash kernel's o/lse residuals (O(seq) per layer,
+        # tagged via checkpoint_name in ops/pallas/flash_attention.py) so
+        # backward skips the fwd kernel re-run; everything else — all
+        # param matmuls included — is recomputed. On the einsum path no
+        # tensor carries these names, so it degenerates to `full`.
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse")
     if name == "full":
         return None  # save nothing, recompute all
     raise ValueError(f"unknown remat_policy {name!r}")

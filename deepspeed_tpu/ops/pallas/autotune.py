@@ -58,6 +58,13 @@ for _kind in ("TPU v4", "TPU v5 lite", "TPU v5e", "TPU v5p", "TPU v6 lite",
     for _dt in ("bfloat16", "float32"):
         PRETUNED[(_kind, 1024, 128, _dt, True)] = (512, 256)
         PRETUNED[(_kind, 2048, 128, _dt, True)] = (512, 256)
+        # Long-context seeds: past 2048 the inner k loop dominates the
+        # grid, so block_k doubles to 512 to halve k iterations (a
+        # 512x128 k/v tile is 128 KiB in bf16 — q, k, v, o plus the
+        # f32 acc/lse scratch stay well under the ~16 MiB VMEM budget)
+        # while block_q holds at 512: q tiles scale launches, not reuse.
+        PRETUNED[(_kind, 4096, 128, _dt, True)] = (512, 512)
+        PRETUNED[(_kind, 8192, 128, _dt, True)] = (512, 512)
 
 _lock = threading.Lock()
 _mem_cache: Dict[str, Tuple[int, int]] = {}

@@ -15,12 +15,23 @@ import functools
 
 import jax
 
+# jax.memory.Space came and went across versions; TransferToMemoryKind is
+# the stable spelling of "same sharding, different memory space" (usable
+# inside jit). Exported from jax.sharding in newer releases only.
+try:
+    from jax.sharding import TransferToMemoryKind as _ToMemKind
+except ImportError:
+    try:
+        from jax._src.sharding_impls import TransferToMemoryKind as _ToMemKind
+    except ImportError:
+        _ToMemKind = None
+
 
 @functools.cache
 def _host_memory_supported() -> bool:
     # SPMD host-memory placement is a TPU feature; the virtual CPU mesh
     # rejects the placement custom-call, so tests run structure-only
-    return jax.devices()[0].platform == "tpu"
+    return _ToMemKind is not None and jax.devices()[0].platform == "tpu"
 
 
 @jax.custom_vjp
@@ -32,7 +43,9 @@ def stream_to_device(x):
     layer-slice at a time, so neither the full parameters NOR the full
     gradients ever exist in HBM — the ZeRO-Infinity memory equation.
     """
-    return jax.device_put(x, jax.memory.Space.Device)
+    if not _host_memory_supported():
+        return x  # structure-only on hosts without memory spaces
+    return jax.device_put(x, _ToMemKind("device"))
 
 
 def _fwd(x):
@@ -41,7 +54,7 @@ def _fwd(x):
 
 def _bwd(_, g):
     if _host_memory_supported():
-        g = jax.device_put(g, jax.memory.Space.Host)
+        g = jax.device_put(g, _ToMemKind("pinned_host"))
     return (g,)
 
 

@@ -25,6 +25,7 @@ TPU re-design (SURVEY.md §7 hard part (a)):
 
 import contextlib
 import os
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -233,6 +234,7 @@ class PipelineEngine:
         self._fwd_fns: List[Any] = [None] * self.num_stages
         self._bwd_fns: List[Any] = [None] * self.num_stages
         self._apply_fns: List[Any] = [None] * self.num_stages
+        self._apply_fns_nodonate: List[Any] = [None] * self.num_stages
 
         x = first_inputs
         rng = self._rng
@@ -352,8 +354,9 @@ class PipelineEngine:
                 lambda params, x, gl, rng: b(params, x, gl, rng))
         return self._bwd_fns[s]
 
-    def _apply_fn(self, s):
-        if self._apply_fns[s] is None:
+    def _apply_fn(self, s, donate=True):
+        fns = self._apply_fns if donate else self._apply_fns_nodonate
+        if fns[s] is None:
             tx = self._tx
 
             def apply_step(params, opt_state, acc, factor):
@@ -363,11 +366,12 @@ class PipelineEngine:
                 zero = jax.tree.map(jnp.zeros_like, acc)
                 return new_params, new_opt, zero
 
-            self._apply_fns[s] = jax.jit(
-                apply_step, donate_argnums=(0, 1, 2),
+            kw = {"donate_argnums": (0, 1, 2)} if donate else {}
+            fns[s] = jax.jit(
+                apply_step,
                 out_shardings=(self._param_shardings[s],
-                               self._opt_shardings[s], None))
-        return self._apply_fns[s]
+                               self._opt_shardings[s], None), **kw)
+        return fns[s]
 
     # ------------------------------------------------------------------
     # data plumbing
@@ -613,9 +617,24 @@ class PipelineEngine:
             aargs = (self._params[s], self._opt_states[s],
                      self._acc_grads[s], jnp.float32(clip * factor))
             self._note_mem_call(f"apply_stage{s}", self._apply_fn(s), aargs)
-            self._params[s], self._opt_states[s], self._acc_grads[s] = (
-                self._apply_fn(s)(*aargs)
-            )
+            try:
+                out = self._apply_fn(s)(*aargs)
+            except Exception as e:  # XLA donation-alias rejection
+                # When a stage's params arrive in a different sharding than
+                # the apply program's out_shardings (first step after a
+                # replicated init/restore), XLA cannot alias the donated
+                # input with the resharded output and aborts the launch
+                # with an INTERNAL aliasing error. The buffers are intact
+                # at that point, so rerun through an alias-free program —
+                # donation is only a memory optimization.
+                if "aliased" not in str(e):
+                    raise
+                warnings.warn(
+                    f"stage {s} optimizer apply could not donate its "
+                    f"buffers ({e}); retrying without donation",
+                    RuntimeWarning)
+                out = self._apply_fn(s, donate=False)(*aargs)
+            self._params[s], self._opt_states[s], self._acc_grads[s] = out
 
     # ------------------------------------------------------------------
     # checkpoint (per-stage files; reference saves per-pp-rank states)

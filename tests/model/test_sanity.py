@@ -21,6 +21,7 @@ import os
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.runtime.dataloader import RepeatingLoader
@@ -72,6 +73,7 @@ def test_gpt_zero3_tp_solves_periodic_lm(eight_devices, tmp_path):
     np.testing.assert_allclose(replay, more, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_bert_zero1_solves_mlm(eight_devices):
     from deepspeed_tpu.models.bert import BertForPreTraining, bert_config
 
@@ -98,6 +100,7 @@ def test_bert_zero1_solves_mlm(eight_devices):
     assert losses[-1] < 0.5, losses[-5:]
 
 
+@pytest.mark.slow
 def test_moe_gpt_solves_periodic_lm(eight_devices):
     from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
 

@@ -45,8 +45,11 @@ def test_checkpoint_matches_plain(remat):
 
     np.testing.assert_allclose(np.asarray(val), np.asarray(plain_val),
                                rtol=1e-6)
+    # atol floor for near-zero grads: the checkpointed and plain programs
+    # compile to different fusion orders, so elements at the 1e-5 scale
+    # differ in the last ulps — rtol alone flags them as 4e-3 "errors"
     np.testing.assert_allclose(np.asarray(grad), np.asarray(plain_grad),
-                               rtol=1e-5)
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_checkpoint_wrapper_under_jit():

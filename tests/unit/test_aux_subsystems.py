@@ -558,6 +558,7 @@ class TestCompressionEngineWiring:
 
     @pytest.mark.parametrize("technique", ["head_pruning", "row_pruning",
                                            "channel_pruning"])
+    @pytest.mark.slow
     def test_per_technique_engine_pruning(self, eight_devices, technique):
         """Each pruning technique, engine-wired alone (reference
         tests/unit/compression/ covers one technique per test): the TRAINED

@@ -76,6 +76,7 @@ def _resume_matches(save_mesh, load_mesh, tmp_path, cfg=None, stage=0,
 
 
 class TestReshapeMatrix:
+    @pytest.mark.slow
     def test_fsdp_to_dp(self, eight_devices, tmp_path):
         """ZeRO-3 fsdp=8 save -> plain dp=8 resume (stage change on load
         side uses stage 0 shardings; state is global either way)."""

@@ -47,8 +47,11 @@ def test_initialize_returns_tuple(eight_devices):
 
 def test_train_loss_decreases(eight_devices):
     engine, it = make_engine()
-    losses = [float(engine.train_batch(it)) for _ in range(15)]
-    assert losses[-1] < losses[0] * 0.6, losses
+    # single-batch losses on the 128-sample set are noisy (4 steps/epoch at
+    # global batch 32); compare epoch-aligned means so the trend, not one
+    # draw, decides
+    losses = [float(engine.train_batch(it)) for _ in range(32)]
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.6, losses
 
 
 def test_forward_backward_step_protocol(eight_devices):
@@ -212,6 +215,7 @@ def test_eval_batch(eight_devices):
 @pytest.mark.parametrize("policy,scan", [("full", True),
                                          ("selective", True),
                                          ("full", False)])
+@pytest.mark.slow
 def test_gpt_remat_trains(eight_devices, policy, scan):
     """Regression: nn.remat must keep decode/deterministic static (they
     arrive via closure), in both the scanned and unrolled layer paths."""
@@ -227,6 +231,7 @@ def test_gpt_remat_trains(eight_devices, policy, scan):
     assert all(np.isfinite(losses))
 
 
+@pytest.mark.slow
 def test_pure_bf16_param_dtype_trains(eight_devices):
     """Regression: with param_dtype=bf16 (pure-bf16 training — how GPT-2
     1.3B fits one chip) the optimizer must consume grads in the param

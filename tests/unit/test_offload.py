@@ -32,11 +32,13 @@ def make_engine(offload_device="cpu", nvme_path=None, **over):
 class TestZeroOffload:
     def test_trains_and_no_device_opt_state(self, eight_devices):
         engine, it = make_engine("cpu")
-        losses = [float(engine.train_batch(it)) for _ in range(15)]
-        assert losses[-1] < losses[0] * 0.6, losses
+        losses = [float(engine.train_batch(it)) for _ in range(32)]
+        # epoch-aligned means (4 steps/epoch on the 128-sample set): single
+        # batches differ in difficulty, so step-vs-step comparison is noise
+        assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.6, losses
         assert engine._opt_state is None  # zero optimizer bytes on device
         assert engine._offload_opt is not None
-        assert engine._offload_opt.cpu_adam.step_count == 15
+        assert engine._offload_opt.cpu_adam.step_count == 32
 
     def test_matches_device_adamw(self, eight_devices):
         e_off, it_off = make_engine("cpu")

@@ -105,6 +105,7 @@ class TestNVMeParamTier:
                 got[slot], flat(ref_grads[pi]), rtol=2e-4, atol=2e-5,
                 err_msg=f"layer {pi}")
 
+    @pytest.mark.slow
     def test_deterministic_across_runs(self, tmp_path):
         l1 = [float(_engine(tmp_path / "a").train_batch(iter([_batch()])))
               for _ in range(1)]

@@ -109,6 +109,7 @@ class TestPipelineEngine:
             out.append({"input_ids": ids, "labels": ids})
         return out
 
+    @pytest.mark.slow
     def test_train_batch_runs_and_learns(self, eight_devices):
         engine, cfg, topo = self._build(eight_devices)
         gb = engine.train_micro_batch_size_per_gpu * topo.data_parallel_size
@@ -200,6 +201,7 @@ class TestPipelineEngine:
         assert np.isfinite(losses).all(), losses
         assert engine.curriculum_scheduler.get_current_difficulty() == 32
 
+    @pytest.mark.slow
     def test_pld_composes_with_pipeline(self, eight_devices):
         """Progressive layer drop threads theta into every stage's fwd/bwd
         programs; blocks gate by GLOBAL depth so the schedule is
@@ -222,6 +224,7 @@ class TestPipelineEngine:
         assert 0.5 < th < 1.0
         assert th == pytest.approx(0.5 + 0.5 * np.exp(-0.1 * 4), rel=1e-6)
 
+    @pytest.mark.slow
     def test_checkpoint_roundtrip(self, eight_devices, tmp_path):
         engine, cfg, topo = self._build(eight_devices, pp=2, dp=4, gas=2)
         gb = engine.train_micro_batch_size_per_gpu * topo.data_parallel_size
@@ -237,6 +240,7 @@ class TestPipelineEngine:
             for lb, la in zip(jax.tree.leaves(b), jax.tree.leaves(a)):
                 np.testing.assert_array_equal(np.asarray(lb), np.asarray(la))
 
+    @pytest.mark.slow
     def test_checkpoint_resumes_optimizer_and_counters(self, eight_devices,
                                                        tmp_path):
         """Same-degree pipeline resume restores optimizer moments and step

@@ -213,6 +213,7 @@ def test_engine_trains_bigbird_from_config_alone():
         "dense [B, H, T, T] score matrix present — sparse path not taken"
 
 
+@pytest.mark.slow
 def test_engine_dense_mode_matches_unsparse_bert():
     """mode=dense must reproduce full attention: same init seed, same batch,
     same first-step loss as a config with no sparse_attention block."""
@@ -338,6 +339,7 @@ def test_gathered_masks_match_dense():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_engine_kernel_selector_from_config():
     """'kernel' in the config block picks the implementation; 'pallas'
     really lands the Pallas kernel in the traced program."""

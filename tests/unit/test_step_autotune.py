@@ -1,0 +1,362 @@
+"""HBM-bounded step autotuner (runtime/step_autotune.py): new selective
+remat policies keep loss/grad parity on both attention paths, analytic
+pruning never executes an over-ceiling candidate, cache resolution
+(mem -> disk -> PRETUNED -> live) with corrupt/invalid fallback, and the
+engine wiring (winner applied to the module, fused-step modes)."""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+from deepspeed_tpu.parallel import mesh
+from deepspeed_tpu.runtime import step_autotune as sa
+from deepspeed_tpu.runtime.config import (
+    DeepSpeedConfig,
+    DeepSpeedConfigError,
+    StepAutotuneConfig,
+)
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+from deepspeed_tpu.runtime.step_autotune import (
+    StepCandidate,
+    cache_key,
+    cache_path,
+    candidate_grid,
+    clear_memory_cache,
+    get_step_config,
+    model_key,
+    search,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv(sa._CACHE_ENV, str(tmp_path / "step_configs.json"))
+    monkeypatch.delenv(sa._AUTOTUNE_ENV, raising=False)
+    clear_memory_cache()
+    yield
+    clear_memory_cache()
+
+
+def _gpt(policy, flash, seq):
+    cfg = GPTConfig(
+        vocab_size=256, n_positions=seq, n_embd=64, n_layer=2, n_head=4,
+        dtype=jnp.float32, scan_layers=True, remat=True,
+        remat_policy=policy, use_flash_attention=flash)
+    return GPT(cfg)
+
+
+def _loss_and_grads(model, seq):
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 256, (2, seq)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, deterministic=True)
+
+    def loss_fn(p):
+        return model.apply(p, ids, labels=ids, deterministic=True)
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+class TestRematPolicyParity:
+    """Remat changes what is recomputed, never what is computed: every
+    policy must reproduce ``full``'s loss and gradients exactly."""
+
+    @pytest.mark.parametrize("policy",
+                             ["save_dots", "save_nothing_but_flash"])
+    @pytest.mark.slow
+    def test_einsum_path_parity(self, policy):
+        ref_l, ref_g = _loss_and_grads(_gpt("full", False, 64), 64)
+        got_l, got_g = _loss_and_grads(_gpt(policy, False, 64), 64)
+        np.testing.assert_allclose(got_l, ref_l, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(got_g), jax.tree.leaves(ref_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    @pytest.mark.parametrize("policy",
+                             ["save_dots", "save_nothing_but_flash"])
+    @pytest.mark.slow
+    def test_flash_path_parity(self, policy):
+        # T=128 takes the (interpreted) flash kernel, where the
+        # checkpoint_name-tagged attn_out/attn_lse residuals exist
+        ref_l, ref_g = _loss_and_grads(_gpt("full", True, 128), 128)
+        got_l, got_g = _loss_and_grads(_gpt(policy, True, 128), 128)
+        np.testing.assert_allclose(got_l, ref_l, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(got_g), jax.tree.leaves(ref_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+
+class TestAnalyticPruning:
+    """The no-OOM contract: a candidate whose AOT peak busts the ceiling
+    is recorded (predicted peak + fits=False) but NEVER executed."""
+
+    @staticmethod
+    def _fakes(benched, big_micro=8):
+        def fake_analyze(c):
+            # the big micro batch's dense bound busts any small ceiling
+            peak = 1e12 if c.micro_batch >= big_micro else 1e6
+            return {"peak_working_set_bytes": peak, "argument_bytes": 1.0,
+                    "temp_bytes": 1.0, "flops": 1e9, "bytes_accessed": 1e6}
+
+        def fake_bench(c):
+            benched.append(c)
+            mfu = 0.5 if c.remat_policy == "save_dots" else 0.4
+            return {"analytic_mfu": mfu, "measured_step_s": 0.01,
+                    "fuse_optimizer": True}
+
+        return fake_analyze, fake_bench
+
+    def test_over_ceiling_candidate_rejected_without_execution(self):
+        benched = []
+        fa, fb = self._fakes(benched)
+        report = search(
+            "gpt2-125m", 64, jnp.float32, micro_batches=(2, 8),
+            policies=("full", "save_dots"), flash_options=(False,),
+            hbm_override_gib=1.0, live=True, _analyze=fa, _bench=fb)
+        assert all(c.micro_batch < 8 for c in benched)
+        over = [r for r in report["candidates"] if r["micro_batch"] == 8]
+        assert over, "grid must include the over-ceiling micro batch"
+        for r in over:
+            assert r["fits"] is False
+            assert not r["executed_live"]
+            assert r["predicted_peak_bytes"] == 1e12  # recorded anyway
+        fits = [r for r in report["candidates"] if r["micro_batch"] == 2]
+        assert all(r["executed_live"] for r in fits)
+
+    def test_winner_and_baseline_scoring(self):
+        benched = []
+        fa, fb = self._fakes(benched)
+        report = search(
+            "gpt2-125m", 64, jnp.float32, micro_batches=(2, 8),
+            policies=("full", "save_dots"), flash_options=(False,),
+            hbm_override_gib=1.0, live=True, _analyze=fa, _bench=fb)
+        w = report["winner"]
+        assert (w["remat_policy"], w["micro_batch"]) == ("save_dots", 2)
+        assert report["baseline"]["remat_policy"] == "full"
+        assert report["winner_beats_baseline"]  # 0.5 > 0.4
+
+    def test_unlowerble_candidate_loses_not_crashes(self):
+        def broken_analyze(c):
+            raise ValueError("boom")
+
+        report = search(
+            "gpt2-125m", 64, jnp.float32, micro_batches=(2,),
+            policies=("full",), flash_options=(False,), live=False,
+            _analyze=broken_analyze)
+        row = report["candidates"][0]
+        assert row["fits"] is False and "boom" in row["error"]
+        assert not report["winner_beats_baseline"]
+
+    def test_grid_skips_flashless_alias(self):
+        grid = candidate_grid((2,), ("save_nothing_but_flash",),
+                              (True, False))
+        assert grid == [StepCandidate("save_nothing_but_flash", 2, True)]
+
+
+class TestCacheResolution:
+    KEY_ARGS = ("TPU v4", "gpt2-1.3b", 1024, jnp.bfloat16)
+    ENTRY = {"remat_policy": "save_dots", "micro_batch": 4, "flash": True}
+
+    def test_disk_hit(self):
+        key = cache_key(*self.KEY_ARGS)
+        with open(cache_path(), "w") as f:
+            json.dump({key: self.ENTRY}, f)
+        got = get_step_config("gpt2-1.3b", 1024, jnp.bfloat16,
+                              device_kind="TPU v4")
+        assert got["remat_policy"] == "save_dots"
+        assert got["micro_batch"] == 4 and got["flash"] is True
+        assert got["source"] == "disk"
+
+    def test_corrupt_cache_warns_and_falls_through_to_pretuned(self):
+        with open(cache_path(), "w") as f:
+            f.write("{not json")
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            got = get_step_config("gpt2-1.3b", 1024, jnp.bfloat16,
+                                  device_kind="TPU v4")
+        # the shipped PRETUNED seed still resolves — corruption never
+        # strands the caller
+        assert got is not None and got["source"] == "pretuned"
+
+    def test_invalid_cached_entry_is_rejected(self):
+        key = cache_key("cpu", "gpt2-125m", 64, jnp.float32)
+        with open(cache_path(), "w") as f:
+            json.dump({key: {"remat_policy": "no_such_policy",
+                             "micro_batch": 4, "flash": True}}, f)
+        assert get_step_config("gpt2-125m", 64, jnp.float32,
+                               device_kind="cpu", autotune=False) is None
+
+    def test_pretuned_entries_all_validate(self):
+        for entry in sa.PRETUNED.values():
+            assert sa._valid(entry) is not None
+
+    def test_live_search_persists_winner(self, monkeypatch):
+        calls = []
+
+        def fake_search(model, seq, dtype, **kw):
+            calls.append(model)
+            return {"winner": dict(self.ENTRY, analytic_mfu=0.5),
+                    "device_kind": kw.get("device_kind")}
+
+        monkeypatch.setattr(sa, "search", fake_search)
+        got = get_step_config("gpt2-125m", 64, jnp.float32,
+                              device_kind="cpu", autotune=True)
+        assert got["source"] == "live" and len(calls) == 1
+        # disk hit afterwards: no second search even across processes
+        clear_memory_cache()
+        again = get_step_config("gpt2-125m", 64, jnp.float32,
+                                device_kind="cpu", autotune=True)
+        assert again["source"] == "disk" and len(calls) == 1
+
+    def test_off_means_none_not_search(self, monkeypatch):
+        def exploding_search(*a, **kw):
+            raise AssertionError("search must not run when autotune is off")
+
+        monkeypatch.setattr(sa, "search", exploding_search)
+        assert get_step_config("gpt2-125m", 64, jnp.float32,
+                               device_kind="cpu", autotune=False) is None
+
+
+class TestEngineWiring:
+    CFG = dict(vocab_size=256, n_positions=64, n_embd=64, n_layer=2,
+               n_head=4)
+
+    def _model(self):
+        return GPT(GPTConfig(dtype=jnp.float32, scan_layers=True,
+                             remat=False, remat_policy="full", **self.CFG))
+
+    def _seed_cache(self, winner):
+        model = self._model()
+        key = cache_key(jax.devices()[0].device_kind,
+                        model_key(model.config),
+                        model.config.n_positions, model.config.dtype)
+        with open(cache_path(), "w") as f:
+            json.dump({key: winner}, f)
+
+    def _init(self, ds_extra):
+        mesh.reset_default_topology()
+        cfg = {"train_micro_batch_size_per_gpu": 2,
+               "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+               "steps_per_print": 10 ** 9}
+        cfg.update(ds_extra)
+        return deepspeed_tpu.initialize(model=self._model(), config=cfg)[0]
+
+    def test_cached_winner_rebuilds_module_and_micro_batch(self):
+        self._seed_cache({"remat_policy": "save_dots", "micro_batch": 4,
+                          "flash": True})
+        engine = self._init({"tpu": {"step_autotune": {
+            "enabled": True, "apply_micro_batch": True}}})
+        mc = engine.module.config
+        assert mc.remat and mc.remat_policy == "save_dots"
+        assert mc.use_flash_attention is True
+        assert engine.train_micro_batch_size_per_gpu == 4
+        # the batch triad re-derived against the actual mesh
+        assert engine._config.train_batch_size == \
+            4 * engine.topology.data_parallel_size
+        assert engine.step_autotune_winner["source"] == "disk"
+
+    def test_default_off_leaves_module_untouched(self):
+        self._seed_cache({"remat_policy": "save_dots", "micro_batch": 4,
+                          "flash": True})
+        engine = self._init({})
+        assert engine.module.config.remat is False
+        assert engine.train_micro_batch_size_per_gpu == 2
+        assert engine.step_autotune_winner is None
+
+    def test_enabled_without_entry_is_a_noop(self):
+        engine = self._init({"tpu": {"step_autotune": {"enabled": True}}})
+        assert engine.module.config.remat is False
+        assert engine.step_autotune_winner is None
+
+    def _train_one(self, engine):
+        rng = np.random.RandomState(0)
+        gb = (engine.train_micro_batch_size_per_gpu
+              * engine.topology.data_parallel_size)
+        ids = rng.randint(0, 256, size=(gb, 64)).astype(np.int32)
+        it = iter(RepeatingLoader([{"input_ids": ids, "labels": ids}]))
+        loss = engine.train_batch(it)
+        assert jnp.isfinite(loss)
+
+    @pytest.mark.slow
+    def test_fused_step_off_forces_two_program_split(self):
+        engine = self._init({"tpu": {"step_autotune": {
+            "fused_step": "off"}}})
+        self._train_one(engine)
+        assert engine._train_step_fn is None  # split path compiled instead
+
+    @pytest.mark.slow
+    def test_fused_step_on_fuses_even_under_wall_clock_breakdown(self):
+        engine = self._init({"wall_clock_breakdown": True,
+                             "tpu": {"step_autotune": {
+                                 "fused_step": "on"}}})
+        self._train_one(engine)
+        assert engine._train_step_fn is not None
+
+    @pytest.mark.slow
+    def test_auto_honors_winner_fuse_verdict(self):
+        # a winner whose live benchmark measured the fused tail faster
+        # flips the auto gating even when wall_clock_breakdown would
+        # otherwise pick the split path
+        self._seed_cache({"remat_policy": "full", "micro_batch": 2,
+                          "flash": False, "fuse_optimizer": True})
+        engine = self._init({"wall_clock_breakdown": True,
+                             "tpu": {"step_autotune": {"enabled": True}}})
+        self._train_one(engine)
+        assert engine._train_step_fn is not None
+
+
+class TestConfigValidation:
+    # like GradExchangeConfig, the sub-block validates at from_dict; the
+    # engine surfaces the error when it resolves tpu.step_autotune_config
+    def test_bad_fused_step_rejected(self):
+        with pytest.raises(DeepSpeedConfigError, match="fused_step"):
+            StepAutotuneConfig.from_dict({"fused_step": "banana"})
+
+    def test_negative_hbm_rejected(self):
+        with pytest.raises(DeepSpeedConfigError, match="hbm_gib"):
+            StepAutotuneConfig.from_dict({"hbm_gib": -1.0})
+
+    def test_live_steps_floor(self):
+        with pytest.raises(DeepSpeedConfigError, match="live_steps"):
+            StepAutotuneConfig.from_dict({"live_steps": 0})
+
+    def test_config_property_surfaces_error(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "tpu": {
+            "step_autotune": {"fused_step": "banana"}}})
+        with pytest.raises(DeepSpeedConfigError, match="fused_step"):
+            cfg.tpu.step_autotune_config
+
+    def test_defaults_are_off(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8})
+        sac = cfg.tpu.step_autotune_config
+        assert not sac.enabled and not sac.autotune
+        assert sac.fused_step == "auto"
+
+
+class TestRooflineTables:
+    def test_device_ceiling_is_backend_free(self):
+        b, src = sa.device_ceiling_bytes("TPU v4")
+        assert b == 32 * 1024 ** 3 and "v4" in src.lower()
+        b, _ = sa.device_ceiling_bytes("TPU v5e", override_gib=1.5)
+        assert b == int(1.5 * 1024 ** 3)
+
+    def test_predict_step_decomposes_the_roofline(self):
+        pred = sa.predict_step(1e12, 1e9, "TPU v4", compute_eff=0.5)
+        assert pred["predicted_step_s"] == pytest.approx(
+            pred["predicted_compute_s"] + pred["predicted_memory_s"])
+        assert 0 < pred["predicted_analytic_mfu"] <= 1
+
+    def test_calibration_recovers_anchor_throughput(self):
+        # at the anchor's own F/B the calibrated roofline must predict the
+        # measured throughput back (the solve is exact, not a fit)
+        flops, byts = 1e13, 1e10
+        c, src = sa.calibrate_compute_efficiency(flops, byts)
+        assert "solved" in src
+        pred = sa.predict_step(
+            flops, byts, sa.CALIBRATION_ANCHOR["device_kind"], c)
+        assert pred["predicted_analytic_tflops"] == pytest.approx(
+            sa.CALIBRATION_ANCHOR["measured_analytic_tflops"], rel=1e-3)

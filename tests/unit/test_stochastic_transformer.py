@@ -39,6 +39,7 @@ def _loss(model, params, ids, pld_theta, rng):
                              "gating": jax.random.fold_in(rng, 7)})
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("scan_layers", [True, False], ids=["scan", "loop"])
 def test_remat_grads_exact(scan_layers):
     """THE stochastic-mode correctness property: gradients with remat equal
@@ -96,6 +97,7 @@ def test_drop_distribution_follows_depth_schedule():
     assert len(outcomes) > 1, "theta=0 never dropped a layer in 24 draws"
 
 
+@pytest.mark.slow
 def test_engine_pld_schedule_drives_stochastic_depth():
     """Engine integration: progressive_layer_drop + stochastic_mode model
     trains, and the in-graph theta makes its training path differ from the

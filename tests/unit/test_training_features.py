@@ -321,6 +321,7 @@ class TestMoQ:
 # curriculum + engine
 # ---------------------------------------------------------------------------
 class TestCurriculumEngine:
+    @pytest.mark.slow
     def test_engine_truncates_seq(self, eight_devices):
         from unit.simple_model import tiny_gpt_config, random_token_batches
         from deepspeed_tpu.models.transformer_lm import GPT
