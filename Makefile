@@ -9,7 +9,8 @@
 #   make smoke   perf regression gate on the real chip
 #                (benchmarks/smoke.py vs committed expected.json, +-10%)
 #   make chaos   fault-injection suite: torn/failed checkpoint writes,
-#                preemption grace saves, crash-loop detection, and the
+#                preemption grace saves, crash-loop detection, elastic
+#                topology resume (8->4 / 4->8 kill-and-reshard), and the
 #                training health sentinel: NaN/spike anomalies, auto-
 #                rollback, hang watchdog (docs/recovery.md)
 #   make profile step-profiler gate on a tiny CPU config: asserts phase
@@ -79,6 +80,7 @@ quick:
 	  tests/unit/test_serving_frontdoor.py \
 	  tests/unit/test_data_pipeline.py tests/unit/test_telemetry.py \
 	  tests/unit/test_step_autotune.py \
+	  tests/unit/test_elastic_reshard.py \
 	  -q -x -m "not slow"
 
 test:
@@ -87,8 +89,12 @@ test:
 smoke:
 	$(PY) benchmarks/smoke.py
 
+# includes the elastic 8->4 / 4->8 topology-resume scenarios (train on N
+# virtual devices, kill mid-epoch, resume on N' — docs/recovery.md
+# "Elastic topology resume"); the slow marker is NOT excluded here
 chaos:
-	$(PY) -m pytest tests/unit/test_fault_tolerance.py tests/unit/test_sentinel.py -q
+	$(PY) -m pytest tests/unit/test_fault_tolerance.py tests/unit/test_sentinel.py \
+	  tests/unit/test_elastic_reshard.py -q
 
 profile:
 	$(PY) benchmarks/profile_step.py

@@ -87,12 +87,31 @@ class PackedDataPipeline:
         }
 
     def load_state_dict(self, state: Dict[str, Any]):
+        saved_shards = (state.get("stream") or {}).get("num_shards")
         self.stream.load_state_dict(state["stream"])
-        self._packer.load_state_dict(state["packer"])
-        self._ready = [
-            {k: np.asarray(v, dtype=np.int32) for k, v in b.items()}
-            for b in state.get("ready", [])
-        ]
+        if (saved_shards is not None
+                and int(saved_shards) != self.stream.num_shards):
+            # elastic re-stride: the stream resumed at the new geometry
+            # (see ShardedSampleStream.load_state_dict). The half-packed
+            # rows and ready batches belong to ONE old-rank's pipeline;
+            # every new rank loads the same state, so exactly one of them
+            # (rank 0) may carry the pending work forward — anywhere else
+            # it would be delivered num_shards times
+            if self.stream.shard_rank == 0:
+                self._packer.load_state_dict(state["packer"])
+                self._ready = [
+                    {k: np.asarray(v, dtype=np.int32) for k, v in b.items()}
+                    for b in state.get("ready", [])
+                ]
+            else:
+                self._packer.reset()
+                self._ready = []
+        else:
+            self._packer.load_state_dict(state["packer"])
+            self._ready = [
+                {k: np.asarray(v, dtype=np.int32) for k, v in b.items()}
+                for b in state.get("ready", [])
+            ]
         self._last_order_version = self.stream.order_version
 
     # -- iteration ---------------------------------------------------------

@@ -6,12 +6,23 @@ recompute exactly which samples it owns from ``(seed, epoch)`` alone, so a
 restore (or an elastic restart on a different host) replays the identical
 stream. This source keeps the ``DeepSpeedDataLoader`` idiom — a fresh
 ``np.random.RandomState(seed + epoch)`` permutation per epoch — and adds
-the two things the batch-level loader cannot express:
+the things the batch-level loader cannot express:
 
-* **sharding**: shard ``r`` of ``n`` owns ``order[r::n]`` truncated to the
-  common length, so shards are disjoint and equally sized in every epoch;
+* **sharding**: shard ``r`` of ``n`` owns global positions ``r, r+n,
+  r+2n, ...`` of the epoch permutation (truncated to the common length
+  ``n * (len // n)``), so shards are disjoint and equally sized;
 * **mid-epoch resume**: ``state_dict`` carries a sample cursor, not just
-  ``(epoch, seed)``, so a restore continues from the exact next document.
+  ``(epoch, seed)``, so a restore continues from the exact next document;
+* **elastic re-stride**: the state also records the shard GEOMETRY
+  (``num_shards``, the global ``epoch_offset`` this incarnation started
+  striding from, and the ``epoch_boundary`` the epoch was started with).
+  Loading it on a DIFFERENT shard count is pure arithmetic: all ranks of
+  the old topology advance in lockstep, so the consumed set is exactly
+  the global-order prefix ``[epoch_offset, epoch_offset + cursor * N)``;
+  the new topology re-strides the remainder ``[frontier, boundary)`` at
+  stride N' — zero samples lost or duplicated, for any (N, N') pair
+  including non-divisor shrinks (property-tested in
+  tests/unit/test_elastic_reshard.py).
 
 ``reseed(offset)`` derives a fresh order (seed = base + offset) and
 restarts the epoch traversal — the sentinel's rollback re-entry path:
@@ -47,41 +58,58 @@ class ShardedSampleStream:
         self.shard_rank = shard_rank
         self.num_shards = num_shards
         self.epoch = 0
-        self.cursor = 0  # samples already drawn from this shard this epoch
+        self.cursor = 0  # samples already drawn by this shard this stride
+        # where this incarnation's stride begins in the epoch's global
+        # order (0 for a fresh epoch; the consumed frontier after an
+        # elastic re-stride) and where the epoch ends (fixed by the
+        # topology that STARTED the epoch — a resumed epoch must keep the
+        # original truncation or samples appear/vanish at the tail)
+        self.epoch_offset = 0
+        self.epoch_boundary = self._default_boundary(num_shards)
         # bumped whenever the order changes out-of-band (reseed or
         # load_state_dict) so downstream stages can restart/flush
         self.order_version = 0
         self._order = None
         self._order_key = None
 
+    def _default_boundary(self, num_shards: int) -> int:
+        return num_shards * (len(self.dataset) // num_shards)
+
     @property
     def samples_per_epoch(self) -> int:
         """Per-shard epoch length (the common truncated length)."""
         return len(self.dataset) // self.num_shards
 
-    def _epoch_order(self) -> np.ndarray:
+    def _full_order(self) -> np.ndarray:
+        """The epoch's GLOBAL permutation — a pure function of
+        (seed, epoch), identical on every rank of every topology."""
         key = (self.seed, self.epoch)
         if self._order_key != key:
             order = np.arange(len(self.dataset))
             if self.shuffle:
                 np.random.RandomState(self.seed + self.epoch).shuffle(order)
-            # interleaved shard, truncated to the common length: disjoint
-            # across ranks, equal-sized, and a pure function of (seed, epoch)
-            self._order = order[self.shard_rank::self.num_shards][
-                :self.samples_per_epoch]
+            self._order = order
             self._order_key = key
         return self._order
+
+    def _next_global(self) -> int:
+        """Global position of this shard's next sample: the stride base
+        plus this rank's interleave offset."""
+        return (self.epoch_offset + self.shard_rank
+                + self.cursor * self.num_shards)
 
     def __iter__(self):
         return self
 
     def __next__(self) -> Any:
-        order = self._epoch_order()
-        if self.cursor >= len(order):
+        g = self._next_global()
+        if g >= self.epoch_boundary:
             self.epoch += 1
             self.cursor = 0
-            order = self._epoch_order()
-        sample = self.dataset[int(order[self.cursor])]
+            self.epoch_offset = 0
+            self.epoch_boundary = self._default_boundary(self.num_shards)
+            g = self._next_global()
+        sample = self.dataset[int(self._full_order()[g])]
         self.cursor += 1
         return sample
 
@@ -91,14 +119,45 @@ class ShardedSampleStream:
         traversal restarted."""
         self.seed = self._base_seed + int(offset)
         self.cursor = 0
+        self.epoch_offset = 0
+        self.epoch_boundary = self._default_boundary(self.num_shards)
         self.order_version += 1
 
     def state_dict(self) -> Dict[str, int]:
         return {"seed": self.seed, "epoch": self.epoch,
-                "cursor": self.cursor}
+                "cursor": self.cursor,
+                "num_shards": self.num_shards,
+                "epoch_offset": self.epoch_offset,
+                "epoch_boundary": self.epoch_boundary}
 
     def load_state_dict(self, state: Dict[str, int]):
+        """Resume, re-striding when the state was saved under a different
+        shard count. All ranks advance in lockstep (the engine steps them
+        together), so a saved ``cursor`` under ``N`` shards means the
+        global prefix ``[epoch_offset, epoch_offset + cursor * N)`` is
+        consumed; the new topology strides the remainder. Legacy three-int
+        states (no geometry) resume same-topology, bit-identical to the
+        old behavior."""
         self.seed = int(state.get("seed", self.seed))
         self.epoch = int(state.get("epoch", self.epoch))
-        self.cursor = int(state.get("cursor", self.cursor))
+        cursor = int(state.get("cursor", self.cursor))
+        saved_shards = state.get("num_shards")
+        saved_offset = int(state.get("epoch_offset", 0))
+        saved_boundary = state.get("epoch_boundary")
+        if saved_shards is None or int(saved_shards) == self.num_shards:
+            # same topology (or pre-geometry state): exact per-rank resume
+            self.cursor = cursor
+            self.epoch_offset = saved_offset
+            self.epoch_boundary = int(
+                saved_boundary if saved_boundary is not None
+                else self._default_boundary(self.num_shards))
+        else:
+            # elastic re-stride: advance the global frontier past what the
+            # old topology consumed, restart this rank's stride there
+            saved_shards = int(saved_shards)
+            self.cursor = 0
+            self.epoch_offset = saved_offset + cursor * saved_shards
+            self.epoch_boundary = int(
+                saved_boundary if saved_boundary is not None
+                else self._default_boundary(saved_shards))
         self.order_version += 1

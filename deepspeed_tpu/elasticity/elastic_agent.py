@@ -124,6 +124,11 @@ class DSElasticAgent:
         self.restart_count = 0
         self._failure_times: List[float] = []
         self._proc: Optional[subprocess.Popen] = None
+        # world size of the previous incarnation when the topology changed
+        # between launches (exported as DS_TPU_ELASTIC_PREV_WORLD so the
+        # worker's load path expects a reshard); None once the world is
+        # stable again
+        self._prev_world: Optional[int] = None
         self._sleep = time.sleep  # seam for tests
 
     # ------------------------------------------------------------------
@@ -131,6 +136,14 @@ class DSElasticAgent:
         env = dict(self.env)
         env["DS_TPU_NUM_PROCS"] = str(world)
         env["DS_TPU_ELASTIC_RESTART"] = str(self.restart_count)
+        if self._prev_world is not None and self._prev_world != world:
+            # topology changed since the last incarnation: the worker's
+            # checkpoint load must expect (and verify) a reshard —
+            # exported TOGETHER with the device count and the last valid
+            # tag below, so the resume sees one consistent picture
+            env[ds_constants.ELASTIC_PREV_WORLD_ENV] = str(self._prev_world)
+        else:
+            env.pop(ds_constants.ELASTIC_PREV_WORLD_ENV, None)
         if self.telemetry_dir:
             from deepspeed_tpu.telemetry.crash_report import (
                 TELEMETRY_DIR_ENV)
@@ -172,11 +185,14 @@ class DSElasticAgent:
                 f"micro={micro} gas={gas}")
         return env
 
-    def _launch(self) -> subprocess.Popen:
+    def _launch(self, world: int) -> subprocess.Popen:
+        return subprocess.Popen(self.cmd, env=self._worker_env(world))
+
+    def _discover(self) -> int:
         world = self.discover_world()
         if world < 1:
             raise ElasticAgentError(f"discovered world size {world} < 1")
-        return subprocess.Popen(self.cmd, env=self._worker_env(world))
+        return world
 
     def _next_backoff(self) -> float:
         """Exponential backoff with jitter: base * 2^(restarts-1), capped,
@@ -209,7 +225,8 @@ class DSElasticAgent:
         Raises :class:`CrashLoopError` when failures cluster tighter than
         ``crash_loop_threshold`` per ``crash_loop_window_s``."""
         while True:
-            self._proc = self._launch()
+            world = self._discover()
+            self._proc = self._launch(world)
             started = time.monotonic()
             try:
                 rc = self._proc.wait()
@@ -232,6 +249,23 @@ class DSElasticAgent:
                 return rc
             now = time.monotonic()
             run_s = now - started
+            new_world = self.discover_world()
+            if new_world >= 1 and new_world != world:
+                # the slice was repaired to a different size: the worker
+                # died BECAUSE the topology changed, not because it is
+                # sick. Restart immediately on the new world — no failure
+                # accounting, no backoff, no restart-budget consumption —
+                # and tell the next incarnation what the old world was so
+                # its checkpoint load expects a reshard. Failures at a
+                # STABLE world still count toward the crash-loop guard.
+                self._prev_world = world
+                logger.warning(
+                    f"worker failed (rc={rc}) and the discovered world "
+                    f"changed {world} -> {new_world}: treating as a "
+                    f"topology change, not a crash; relaunching "
+                    f"immediately with elastic reshard expected")
+                continue
+            self._prev_world = None
             self._failure_times.append(now)
             self._check_crash_loop(now)
             if (self.stable_window_s is not None

@@ -35,6 +35,9 @@ class CheckpointEngine:
         # written by save()/the async writer thread, drained by commit()
         self._manifest_lock = threading.Lock()
         self._manifest_files: Dict[str, Dict[str, Dict[str, object]]] = {}
+        # topology block stamped into the next commit's manifests (set by
+        # the engine before its saves; see runtime/layout.topology_metadata)
+        self._topology_metadata: Optional[Dict[str, Any]] = None
         self.io_retry_count = 0
 
     def create(self, tag: str):
@@ -48,6 +51,14 @@ class CheckpointEngine:
 
     def commit(self, tag: str) -> bool:
         return True
+
+    def set_topology_metadata(self, metadata: Optional[Dict[str, Any]]):
+        """Attach a topology block (world size, zero stage, axis sizes,
+        per-leaf partition specs) to every manifest the next ``commit``
+        writes — what lets a later load on a DIFFERENT device count detect
+        the mismatch and reshard (runtime/reshard.py) instead of failing."""
+        with self._manifest_lock:
+            self._topology_metadata = metadata
 
     # -- manifest bookkeeping -------------------------------------------
     def _record_write(self, path: str, digest: Dict[str, object]):
@@ -65,9 +76,10 @@ class CheckpointEngine:
         not part of the tag's integrity contract and are dropped."""
         with self._manifest_lock:
             recorded, self._manifest_files = self._manifest_files, {}
+            topology = self._topology_metadata
         for d, files in recorded.items():
             if os.path.basename(d) == str(tag):
-                cm.write_manifest(d, tag, files)
+                cm.write_manifest(d, tag, files, topology=topology)
 
 
 def _to_host(tree):

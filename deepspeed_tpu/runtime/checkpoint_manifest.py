@@ -30,7 +30,17 @@ from typing import Callable, Dict, List, Optional
 from deepspeed_tpu.utils.logging import logger
 
 MANIFEST_NAME = "manifest.json"
-MANIFEST_VERSION = 1
+# v1: {version, tag, files}. v2 adds an optional "topology" block (world
+# size, zero stage, axis sizes, per-leaf partition specs) so an elastic
+# resume on a different device count is DETECTED and resharded instead of
+# failing. v1 manifests stay loadable: no topology block means the saved
+# topology is unknowable, so only same-topology resume is supported
+# (runtime/reshard.py raises a clear error naming TOPOLOGY_FIELDS when a
+# topology change was expected).
+MANIFEST_VERSION = 2
+# fields of the v2 topology block, named in back-compat error messages
+TOPOLOGY_FIELDS = ("world_size", "zero_stage", "axis_sizes",
+                   "partition_specs")
 LATEST_NAME = "latest"
 LAST_VALID_TAG_ENV = "DS_TPU_LAST_VALID_TAG"
 
@@ -145,16 +155,20 @@ def manifest_path(tag_dir: str) -> str:
 
 
 def write_manifest(tag_dir: str, tag: str,
-                   files: Dict[str, Dict[str, object]]) -> str:
+                   files: Dict[str, Dict[str, object]],
+                   topology: Optional[Dict] = None) -> str:
     """Write ``tag_dir/manifest.json`` naming every file of the tag with
-    its size and crc32. Written durably LAST, so its presence certifies
-    the whole tag: a crash at any earlier point leaves a tag without a
-    manifest, which loads treat as never-committed."""
+    its size and crc32, plus (v2) the topology the state was laid out for.
+    Written durably LAST, so its presence certifies the whole tag: a crash
+    at any earlier point leaves a tag without a manifest, which loads
+    treat as never-committed."""
     doc = {
         "version": MANIFEST_VERSION,
         "tag": str(tag),
         "files": {name: dict(entry) for name, entry in sorted(files.items())},
     }
+    if topology is not None:
+        doc["topology"] = topology
     payload = json.dumps(doc, indent=2, sort_keys=True).encode()
     path = manifest_path(tag_dir)
     atomic_write_bytes(path, payload)
@@ -168,6 +182,15 @@ def read_manifest(tag_dir: str) -> Optional[Dict]:
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+def manifest_topology(tag_dir: str) -> Optional[Dict]:
+    """The tag's saved topology block, or None for v1/absent manifests
+    (pre-topology-metadata checkpoints: same-topology resume only)."""
+    manifest = read_manifest(tag_dir)
+    if manifest is None:
+        return None
+    return manifest.get("topology")
 
 
 def verify_tag_dir(tag_dir: str, check_data: bool = True

@@ -141,6 +141,14 @@ DIVERGENCE_EXIT_CODE_DEFAULT = 13
 # wedged collective), so it must differ from the divergence code
 SENTINEL_HANG_EXIT_CODE_DEFAULT = 14
 
+# Elastic topology resume (docs/recovery.md "Elastic topology resume"):
+# on a restart where the discovered device count changed, the agent
+# exports the PREVIOUS world size alongside DS_TPU_NUM_PROCS so the
+# worker's load path knows a reshard is expected (runtime/reshard.py
+# turns a metadata-less manifest into a clear error instead of a silent
+# same-topology assumption). Jax-free home so the agent can import it.
+ELASTIC_PREV_WORLD_ENV = "DS_TPU_ELASTIC_PREV_WORLD"
+
 # Telemetry bus + crash-forensics flight recorder block
 # (docs/observability.md "Flight recorder"). The dump-dir env var lives
 # in telemetry/crash_report.py (jax-free) so supervisors share it.

@@ -41,9 +41,9 @@ from deepspeed_tpu.comm.logging import comms_logger
 from deepspeed_tpu.parallel.mesh import (
     MeshTopology,
     set_default_topology,
-    topology_from_config,
 )
 from deepspeed_tpu.runtime import checkpoint_manifest as ckpt_manifest
+from deepspeed_tpu.runtime import layout, reshard
 from deepspeed_tpu.runtime.checkpoint_engine import (
     CheckpointEngine,
     select_checkpoint_engine,
@@ -65,7 +65,6 @@ from deepspeed_tpu.runtime.optimizer import (
     build_optimizer,
     is_compressed_optimizer,
 )
-from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -287,11 +286,15 @@ class DeepSpeedEngine:
         self._fused_step_mode = self._step_autotune_cfg.fused_step
         self.step_autotune_winner = None
         if self._step_autotune_cfg.enabled:
-            model = self._apply_step_autotune(model, config)
+            # key the tuner by the device count this engine will actually
+            # run on (elastic resume on a shrunk/grown slice must re-tune,
+            # not reuse the old topology's winner)
+            ndev = (topology.num_devices if topology is not None
+                    else jax.device_count())
+            model = self._apply_step_autotune(model, config, ndev)
         self.module = model
 
-        if topology is None:
-            topology = topology_from_config(config.tpu.mesh_config)
+        topology = layout.build_topology(config, topology)
         # Compressed gradient exchange (reference runtime/fp16/onebit +
         # runtime/comm/nccl.py:51): either a 1-bit optimizer type or
         # communication_data_type=int8. Both replace XLA's implicit grad
@@ -321,26 +324,11 @@ class DeepSpeedEngine:
             self._compressed_mode in ("int8", "deferred")
             or (self._compressed_mode == "onebit"
                 and config.tpu.compressed_grad_norm))
-        # ZeRO shards over the fsdp axis: when the user asked for a ZeRO stage
-        # but left all data parallelism on `dp`, move it to `fsdp` (the mesh
-        # expression of "partition across the DP world",
-        # reference stage_1_and_2.py partitioning over the DP group).
-        # Compressed modes keep the axis on `dp`: the exchange needs the
-        # full momentum/gradient materialized per worker (reference 1-bit
-        # optimizers are likewise limited to ZeRO stages 0-1, onebit/adam.py).
-        if (config.zero_config.stage >= 1 and topology.size("fsdp") == 1
-                and topology.size("dp") > 1
-                and self._compressed_mode is None):
-            sizes = dict(topology.axis_sizes)
-            sizes["fsdp"] = sizes.pop("dp")
-            sizes["dp"] = 1
-            topology = MeshTopology(
-                **sizes, devices=list(topology.mesh.devices.flat)
-            )
-            log_dist(
-                f"zero stage {config.zero_config.stage}: data-parallel axis "
-                f"moved to fsdp ({topology})", ranks=[0],
-            )
+        # mesh/layout decisions live in runtime/layout.py so the elastic
+        # reshard pass can re-derive them without an engine
+        topology = layout.apply_zero_fsdp_move(
+            topology, config.zero_config.stage,
+            compressed=self._compressed_mode is not None)
         self.topology = topology
         set_default_topology(topology)
         # (re)resolve the batch triad against the actual mesh; also validates
@@ -350,11 +338,10 @@ class DeepSpeedEngine:
         comms_logger.configure(config.comms_logger)
 
         self.zero_stage = config.zero_config.stage
-        self.sharding_rules = ZeroShardingRules(
-            topology,
-            stage=self.zero_stage,
-            param_persistence_threshold=config.zero_config.param_persistence_threshold
-            if self.zero_stage >= 3 else 0,
+        self.sharding_rules = layout.build_sharding_rules(
+            topology, self.zero_stage,
+            param_persistence_threshold=(
+                config.zero_config.param_persistence_threshold),
             tp_rules=getattr(model, "tp_rules", None),
         )
 
@@ -432,6 +419,7 @@ class DeepSpeedEngine:
             "ckpt_saves": 0,
             "ckpt_loads": 0,
             "ckpt_fallbacks": 0,
+            "ckpt_reshards": 0,
             "graceful_shutdowns": 0,
         }
         # preemption grace handler (config-gated): the signal handler only
@@ -1594,7 +1582,7 @@ class DeepSpeedEngine:
             logger.warning(f"compiled_step_cost unavailable: {e}")
             return None
 
-    def _apply_step_autotune(self, model, config):
+    def _apply_step_autotune(self, model, config, num_devices=None):
         """Resolve the tuned step config for this module/device and clone
         the module with the winner's remat policy / flash setting (the
         ``apply_sparse_attention`` pattern: the model is rebuilt from
@@ -1619,6 +1607,7 @@ class DeepSpeedEngine:
             search_kwargs["hbm_override_gib"] = sac.hbm_gib
         winner = sa.get_step_config(
             sa.model_key(cfg), cfg.n_positions, cfg.dtype,
+            num_devices=num_devices,
             autotune=True if sac.autotune else None,
             search_kwargs=search_kwargs)
         if winner is None:
@@ -2423,6 +2412,22 @@ class DeepSpeedEngine:
             tag = f"global_step{self.global_steps}"
         client_state = client_state or {}
 
+        # stamp the manifest with this engine's layout (world size, zero
+        # stage, axis sizes, per-leaf partition specs): a later load on a
+        # different device count detects the mismatch and reshards
+        # (runtime/reshard.py) instead of failing
+        specs = {}
+        if getattr(self, "_param_shardings", None) is not None:
+            specs["params"] = layout.describe_shardings(
+                self._param_shardings, self._params)
+        if (getattr(self, "_opt_shardings", None) is not None
+                and self._offload_opt is None):
+            specs["opt_state"] = layout.describe_shardings(
+                self._opt_shardings, self._opt_state)
+        self.checkpoint_engine.set_topology_metadata(
+            layout.topology_metadata(self.topology, self.zero_stage,
+                                     partition_specs=specs or None))
+
         self._save_sharded(
             serialization.to_state_dict(self._params), save_dir, tag,
             "model",
@@ -2572,6 +2577,21 @@ class DeepSpeedEngine:
             "run one forward (or init) before load_checkpoint so state "
             "templates exist"
         )
+        # detect a topology-changed load (elastic resume on N' != N): the
+        # manifest's topology block vs this engine's live layout. A v1
+        # manifest (no block) only supports same-topology resume —
+        # reshard.decide raises a clear error naming the missing fields
+        # when the elastic agent signalled a world-size change.
+        reshard_decision = reshard.decide(
+            load_dir, tag, self.topology, zero_stage=self.zero_stage)
+        reshard_phases = {"detect_s": reshard_decision.detect_s}
+        if reshard_decision.needed:
+            log_dist(
+                f"[reshard] tag {tag}: {reshard_decision.describe()}; "
+                f"re-laying-out state for {self.topology}", ranks=[0])
+        saved_specs = ((reshard_decision.saved or {}).get("partition_specs")
+                       or {})
+        _t_load = time.monotonic()
         model_state = self.checkpoint_engine.load(
             self._model_states_path(load_dir, tag)
         )
@@ -2597,10 +2617,18 @@ class DeepSpeedEngine:
         model_sd = self._merge_expert_files(
             model_state["module"], model_state.get("moe_experts"),
             load_dir, tag, "model")
+        reshard_phases["load_s"] = time.monotonic() - _t_load
+        if reshard_decision.needed and "params" in saved_specs:
+            # gather already happened at save (logical arrays on disk);
+            # verify the loaded leaves against the saved per-leaf record
+            # before trusting them with a re-layout
+            _, verify_s = reshard.verify_state_dict(
+                model_sd, saved_specs["params"], "model")
+            reshard_phases["verify_params_s"] = verify_s
         restored = serialization.from_state_dict(self._params, model_sd)
-        self._params = jax.jit(
-            lambda t: t, out_shardings=self._param_shardings
-        )(restored)
+        self._params, place_s = reshard.place_tree(
+            restored, self._param_shardings)
+        reshard_phases["place_params_s"] = place_s
         if self._offload_opt is not None and not load_optimizer_states:
             # offload steps rebuild device params FROM the host masters, so
             # restored weights must be copied into them (load_state_dict
@@ -2629,6 +2657,10 @@ class DeepSpeedEngine:
                 opt_sd = self._merge_expert_files(
                     optim_state["optimizer"],
                     optim_state.get("moe_experts"), load_dir, tag, "optim")
+                if reshard_decision.needed and "opt_state" in saved_specs:
+                    _, verify_s = reshard.verify_state_dict(
+                        opt_sd, saved_specs["opt_state"], "optimizer")
+                    reshard_phases["verify_opt_s"] = verify_s
                 if (self._compressed_mode == "int8"
                         and isinstance(opt_sd, dict)
                         and "2" not in opt_sd and "1" in opt_sd):
@@ -2641,9 +2673,9 @@ class DeepSpeedEngine:
                 restored_opt = serialization.from_state_dict(
                     self._opt_state, opt_sd
                 )
-                self._opt_state = jax.jit(
-                    lambda t: t, out_shardings=self._opt_shardings
-                )(restored_opt)
+                self._opt_state, place_s = reshard.place_tree(
+                    restored_opt, self._opt_shardings)
+                reshard_phases["place_opt_s"] = place_s
             ls = optim_state.get("loss_scale", {})
             if ls and self._ls_state is not None:
                 self._ls_state = self._ls_state._replace(
@@ -2652,5 +2684,19 @@ class DeepSpeedEngine:
                     hysteresis=jnp.int32(ls["hysteresis"]),
                 )
         self.ft_stats["ckpt_loads"] += 1
+        if reshard_decision.needed:
+            reshard_phases["total_s"] = sum(reshard_phases.values())
+            self.ft_stats["ckpt_reshards"] += 1
+            self._publish_telemetry(
+                "elastic.reshard", tag=str(tag),
+                saved_world=reshard_decision.saved_world,
+                current_world=self.topology.num_devices,
+                mismatches="; ".join(reshard_decision.mismatches),
+                **{k: round(v, 6) for k, v in reshard_phases.items()})
+            log_dist(
+                f"[reshard] tag {tag} re-laid-out in "
+                f"{reshard_phases['total_s']:.3f}s "
+                f"({reshard_decision.saved_world} -> "
+                f"{self.topology.num_devices} devices)", ranks=[0])
         self._emit_ft_events()
         return tag, meta.get("client_state", {})

@@ -1,0 +1,167 @@
+"""Checkpoint re-layout across device-count changes (elastic resume).
+
+A ZeRO-partitioned state tree checkpointed at N devices is re-laid-out for
+N' in three phases:
+
+1. **detect** — read the saved topology block from the tag's manifest
+   (``checkpoint_manifest.manifest_topology``) and diff it against the live
+   :class:`MeshTopology` (``layout.topology_matches``). No block (a v1
+   manifest) means the saved topology is unknowable: only same-topology
+   resume is safe, and an *expected* topology change becomes a clear error
+   instead of silent corruption.
+2. **gather / verify** — checkpoints store LOGICAL (global) arrays
+   (``checkpoint_engine._to_host`` gathers shards at save), so the gather
+   already happened at save time; what remains is verifying each loaded
+   leaf's global shape against the per-leaf record saved alongside the
+   partition specs, so a leaf that drifted (truncated file, wrong tag)
+   fails here with a named path instead of inside ``device_put``.
+3. **place** — re-partition every leaf against the NEW topology's sharding
+   tree (a jit identity with ``out_shardings``, exactly the engine's
+   normal load path — resharding is a property of placement, not a
+   separate copy pass).
+
+The caller (``DeepSpeedEngine.load_checkpoint``) stitches the phases into
+an ``elastic.reshard`` telemetry event with per-phase timings.
+"""
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.parallel.mesh import MeshTopology
+from deepspeed_tpu.runtime import checkpoint_manifest as cm
+from deepspeed_tpu.runtime import layout
+from deepspeed_tpu.runtime.constants import ELASTIC_PREV_WORLD_ENV
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.tree import flatten_dots
+
+
+class ReshardError(RuntimeError):
+    """A topology-changed load that cannot proceed safely."""
+
+
+@dataclass
+class ReshardDecision:
+    """Outcome of the detect phase for one (tag, live-topology) pair."""
+
+    saved: Optional[Dict[str, Any]]  # manifest topology block (None = v1)
+    mismatches: List[str] = field(default_factory=list)
+    detect_s: float = 0.0
+
+    @property
+    def needed(self) -> bool:
+        return bool(self.mismatches)
+
+    @property
+    def saved_world(self) -> Optional[int]:
+        if self.saved is None:
+            return None
+        ws = self.saved.get("world_size")
+        return None if ws is None else int(ws)
+
+    def describe(self) -> str:
+        if self.saved is None:
+            return "no saved topology metadata (pre-v2 manifest)"
+        if not self.mismatches:
+            return "saved topology matches live topology"
+        return "topology changed: " + ", ".join(self.mismatches)
+
+
+def decide(load_dir: str, tag: str, topology: MeshTopology,
+           zero_stage: Optional[int] = None,
+           expect_reshard: Optional[bool] = None) -> ReshardDecision:
+    """Detect phase. ``expect_reshard`` is the elastic agent's signal
+    (``DS_TPU_ELASTIC_PREV_WORLD`` differing from the live world): when a
+    reshard is expected but the manifest predates topology metadata, the
+    load must fail loudly — the fields needed to verify the re-layout
+    simply are not there."""
+    t0 = time.monotonic()
+    saved = cm.manifest_topology(os.path.join(load_dir, str(tag)))
+    if expect_reshard is None:
+        prev = os.environ.get(ELASTIC_PREV_WORLD_ENV)
+        expect_reshard = (prev is not None
+                          and int(prev) != topology.num_devices)
+    if saved is None:
+        if expect_reshard:
+            raise ReshardError(
+                f"checkpoint tag {tag!r} at {load_dir} predates topology "
+                f"metadata (manifest version < {cm.MANIFEST_VERSION}: "
+                f"missing fields "
+                f"{', '.join(cm.TOPOLOGY_FIELDS)}). A topology-changed "
+                f"resume needs them to verify the re-layout; only "
+                f"same-topology resume is supported for this checkpoint. "
+                f"Re-save once on the original topology to upgrade it.")
+        return ReshardDecision(saved=None,
+                               detect_s=time.monotonic() - t0)
+    mismatches = layout.topology_matches(saved, topology,
+                                         zero_stage=zero_stage)
+    return ReshardDecision(saved=saved, mismatches=mismatches,
+                           detect_s=time.monotonic() - t0)
+
+
+def verify_state_dict(state_sd: Dict[str, Any],
+                      saved_specs: Dict[str, Dict[str, Any]],
+                      label: str) -> Tuple[int, float]:
+    """Gather/verify phase: every loaded leaf whose path has a saved
+    per-leaf record must match the recorded GLOBAL shape (the checkpoint
+    stores logical arrays, so the shapes are topology-independent — a
+    mismatch means the payload is not what the manifest described).
+    Returns (leaves verified, elapsed seconds); raises ReshardError with
+    the offending paths on mismatch."""
+    t0 = time.monotonic()
+    flat = flatten_dots(state_sd)
+    bad: List[str] = []
+    checked = 0
+    for key, leaf in flat.items():
+        rec = saved_specs.get(key.replace(".", "/"))
+        if rec is None or "shape" not in rec:
+            continue
+        checked += 1
+        want = tuple(int(d) for d in rec["shape"])
+        got = tuple(np.shape(leaf))
+        if want != got:
+            bad.append(f"{key}: saved {want}, loaded {got}")
+    if bad:
+        raise ReshardError(
+            f"{label} state does not match the saved partition record for "
+            f"{len(bad)} leaf/leaves: " + "; ".join(bad[:5])
+            + ("; ..." if len(bad) > 5 else ""))
+    return checked, time.monotonic() - t0
+
+
+def gather_tree(tree: Any) -> Any:
+    """Gather device arrays (sharded or not) to host numpy copies — the
+    logical view a checkpoint stores, and the interchange format between
+    two topologies (used by the N -> N' -> N round-trip tests and any
+    in-process re-layout that skips the filesystem)."""
+    return jax.tree.map(
+        lambda x: np.array(jax.device_get(x), copy=True), tree)
+
+
+def place_tree(tree: Any, shardings: Any) -> Tuple[Any, float]:
+    """Place phase: partition host (or differently-sharded device) leaves
+    against a sharding tree. The jit identity with ``out_shardings`` is the
+    engine's own load-path placement — XLA moves/reshards each leaf.
+    Returns (placed tree, elapsed seconds); the placed tree is block_until_
+    ready so the timing covers the actual transfer."""
+    t0 = time.monotonic()
+    placed = jax.jit(lambda t: t, out_shardings=shardings)(tree)
+    jax.block_until_ready(placed)
+    return placed, time.monotonic() - t0
+
+
+def reshard_tree(tree: Any, shardings: Any) -> Tuple[Any, Dict[str, float]]:
+    """Gather + place in one call: re-lay-out a live tree (sharded for one
+    topology) against another topology's sharding tree. The explicit host
+    hop is what makes cross-MESH movement legal — a jit identity cannot
+    consume arrays committed to a different mesh's devices."""
+    t0 = time.monotonic()
+    host = gather_tree(tree)
+    gather_s = time.monotonic() - t0
+    placed, place_s = place_tree(host, shardings)
+    return placed, {"gather_s": gather_s, "place_s": place_s,
+                    "total_s": gather_s + place_s}

@@ -23,7 +23,8 @@ mem -> disk -> PRETUNED -> live chain of ``ops/pallas/autotune.py``:
 1. in-memory cache (one lookup per process per key)
 2. on-disk JSON cache — ``$DS_TPU_STEP_AUTOTUNE_CACHE`` or
    ``~/.cache/deepspeed_tpu/step_configs.json``, keyed
-   ``device_kind|model|seq|dtype``; corrupt files warn once and fall
+   ``device_kind|nN|model|seq|dtype`` (N = device count, so an elastic
+   topology change re-tunes); corrupt files warn once and fall
    through, overwritten by the next tuned write.
 3. shipped :data:`PRETUNED` table — seeds from the committed
    ``benchmarks/mfu_search_results.json`` search artifact.
@@ -124,10 +125,16 @@ def cache_path() -> str:
         "step_configs.json")
 
 
-def cache_key(device_kind: str, model: str, seq: int, dtype) -> str:
+def cache_key(device_kind: str, model: str, seq: int, dtype,
+              num_devices: int = 1) -> str:
+    """``device_kind|nN|model|seq|dtype`` — the device COUNT is part of the
+    key so an elastic resume on a shrunk/grown slice re-tunes instead of
+    reusing the old topology's remat×micro winner (the HBM headroom and
+    per-device batch landscape both move with N)."""
     import jax.numpy as jnp
 
-    return f"{device_kind}|{model}|{int(seq)}|{jnp.dtype(dtype).name}"
+    return (f"{device_kind}|n{int(num_devices)}|{model}|{int(seq)}|"
+            f"{jnp.dtype(dtype).name}")
 
 
 def _load_disk_cache() -> Dict[str, Dict[str, Any]]:
@@ -639,6 +646,7 @@ def winner_entry(report: Dict[str, Any]) -> Dict[str, Any]:
 
 def get_step_config(model: str, seq: int, dtype=None, *,
                     device_kind: Optional[str] = None,
+                    num_devices: Optional[int] = None,
                     autotune: Optional[bool] = None,
                     search_kwargs: Optional[Dict[str, Any]] = None
                     ) -> Optional[Dict[str, Any]]:
@@ -647,6 +655,10 @@ def get_step_config(model: str, seq: int, dtype=None, *,
 
     ``autotune=None`` defers to the ``DS_TPU_STEP_AUTOTUNE`` env flag;
     ``search_kwargs`` feeds the live :func:`search` on a miss.
+    ``num_devices`` keys the cache (default: the visible device count) —
+    a topology change misses the old entry and re-resolves. PRETUNED
+    entries stay per-chip (micro_batch is per device), so they remain the
+    fallback at any count.
     """
     import jax
     import jax.numpy as jnp
@@ -657,7 +669,12 @@ def get_step_config(model: str, seq: int, dtype=None, *,
             device_kind = jax.devices()[0].device_kind
         except Exception:
             return None
-    key = cache_key(device_kind, model, seq, dtype)
+    if num_devices is None:
+        try:
+            num_devices = jax.device_count()
+        except Exception:
+            num_devices = 1
+    key = cache_key(device_kind, model, seq, dtype, num_devices)
 
     with _lock:
         hit = _mem_cache.get(key)

@@ -47,6 +47,7 @@ KIND_SERVE_PREFIX_MISS = "serve.prefix_miss"
 KIND_SERVE_PREFIX_EVICT = "serve.prefix_evict"
 KIND_SERVE_SHED = "serve.shed"
 KIND_SHUTDOWN = "shutdown.graceful"
+KIND_ELASTIC_RESHARD = "elastic.reshard"
 
 
 def _default_rank() -> int:

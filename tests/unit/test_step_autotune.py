@@ -163,7 +163,7 @@ class TestCacheResolution:
     ENTRY = {"remat_policy": "save_dots", "micro_batch": 4, "flash": True}
 
     def test_disk_hit(self):
-        key = cache_key(*self.KEY_ARGS)
+        key = cache_key(*self.KEY_ARGS, num_devices=jax.device_count())
         with open(cache_path(), "w") as f:
             json.dump({key: self.ENTRY}, f)
         got = get_step_config("gpt2-1.3b", 1024, jnp.bfloat16,
@@ -183,7 +183,8 @@ class TestCacheResolution:
         assert got is not None and got["source"] == "pretuned"
 
     def test_invalid_cached_entry_is_rejected(self):
-        key = cache_key("cpu", "gpt2-125m", 64, jnp.float32)
+        key = cache_key("cpu", "gpt2-125m", 64, jnp.float32,
+                        num_devices=jax.device_count())
         with open(cache_path(), "w") as f:
             json.dump({key: {"remat_policy": "no_such_policy",
                              "micro_batch": 4, "flash": True}}, f)
@@ -233,7 +234,8 @@ class TestEngineWiring:
         model = self._model()
         key = cache_key(jax.devices()[0].device_kind,
                         model_key(model.config),
-                        model.config.n_positions, model.config.dtype)
+                        model.config.n_positions, model.config.dtype,
+                        num_devices=jax.device_count())
         with open(cache_path(), "w") as f:
             json.dump({key: winner}, f)
 
