@@ -10,9 +10,15 @@
 #                (benchmarks/smoke.py vs committed expected.json, +-10%)
 #   make chaos   fault-injection suite: torn/failed checkpoint writes,
 #                preemption grace saves, crash-loop detection, elastic
-#                topology resume (8->4 / 4->8 kill-and-reshard), and the
+#                topology resume (8->4 / 4->8 kill-and-reshard), the
 #                training health sentinel: NaN/spike anomalies, auto-
-#                rollback, hang watchdog (docs/recovery.md)
+#                rollback, hang watchdog (docs/recovery.md), and the
+#                serving-fleet failover units
+#   make chaos-serve  kill-a-replica-mid-decode scenario: one of N
+#                serving replicas is SIGKILLed while decoding; asserts
+#                zero lost requests, token-identical failover replays,
+#                and one serve.failover per migrated request (commits
+#                benchmarks/inference/failover_bench_results.json)
 #   make profile step-profiler gate on a tiny CPU config: asserts phase
 #                breakdown sums to step wall time, analytic MFU from the
 #                compiled step, and a perfetto-loadable trace
@@ -62,9 +68,9 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/inference/engine.py \
              deepspeed_tpu/runtime/step_autotune.py
 
-.PHONY: quick test smoke chaos profile blackbox memreport check hooks \
-        hot-changed serve-bench serve-bench-uniform data-bench dryrun \
-        mfu-search mfu-search-full overlap-measured
+.PHONY: quick test smoke chaos chaos-serve profile blackbox memreport \
+        check hooks hot-changed serve-bench serve-bench-uniform data-bench \
+        dryrun mfu-search mfu-search-full overlap-measured
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
 # process-group units, with tests marked `slow` (pyproject marker) opted
@@ -78,6 +84,7 @@ quick:
 	  tests/unit/test_flash_autotune.py tests/unit/test_procgroup.py \
 	  tests/unit/test_launcher.py tests/unit/test_serving.py \
 	  tests/unit/test_serving_frontdoor.py \
+	  tests/unit/test_serving_fleet.py \
 	  tests/unit/test_data_pipeline.py tests/unit/test_telemetry.py \
 	  tests/unit/test_step_autotune.py \
 	  tests/unit/test_elastic_reshard.py \
@@ -94,7 +101,14 @@ smoke:
 # "Elastic topology resume"); the slow marker is NOT excluded here
 chaos:
 	$(PY) -m pytest tests/unit/test_fault_tolerance.py tests/unit/test_sentinel.py \
-	  tests/unit/test_elastic_reshard.py -q
+	  tests/unit/test_elastic_reshard.py tests/unit/test_serving_fleet.py -q
+
+# serving-fleet kill scenario: three runs over one trace (in-process
+# reference, fleet baseline, fleet with a mid-decode SIGKILL) proving
+# the exact-failover contract end to end (docs/recovery.md "Serving
+# failover"); exits nonzero on any lost request or token divergence
+chaos-serve:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/inference/chaos_serve.py
 
 profile:
 	$(PY) benchmarks/profile_step.py

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Chaos scenario: kill one of N serving replicas mid-decode and PROVE
+the failover contract.
+
+Three runs over the same bursty prefix trace, same weights (seed 0):
+
+1. **reference** — one in-process scheduler serves every request
+   uninterrupted. Greedy decode is a pure function of (weights,
+   prompt), so these completions are the ground truth every fleet run
+   must reproduce token-for-token.
+2. **baseline** — the multi-process fleet with no kill (the healthy
+   p95 TTFT).
+3. **chaos** — the fleet again, hard-killing the most-loaded replica
+   after it has delivered a handful of tokens. The coordinator replays
+   the dead replica's in-flight requests on survivors.
+
+Hard assertions (exit 1 on any failure):
+
+* zero lost requests — every request completes with its full token
+  budget despite the kill;
+* every completion in the chaos run (migrated ones included) is
+  token-identical to the uninterrupted reference;
+* exactly ONE ``serve.failover`` event per migrated request;
+* the killed replica emits exactly one ``serve.replica_down``.
+
+The JSON artifact records p95 TTFT for the baseline, the chaos run,
+and the no-failover counterfactual (same kill, no replay: every
+migrated request is simply lost) — the number this subsystem exists to
+improve.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/inference/chaos_serve.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "failover_bench_results.json")
+
+
+def _p95(xs):
+    xs = sorted(x for x in xs if x is not None)
+    if not xs:
+        return None
+    return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+
+def reference_completions(prompts, max_new):
+    """Uninterrupted single-process ground truth, same weights/config
+    as every fleet replica."""
+    from examples.serve_router import SERVING_CFG, build_engine
+
+    from deepspeed_tpu.serving import build_serving
+
+    sched = build_serving(build_engine(seed=0), dict(SERVING_CFG))
+    order = [sched.submit(list(p), max_new_tokens=max_new)
+             for p in prompts]
+    stats = sched.run()
+    by_rid = {c.request_id: list(c.tokens) for c in stats.completions}
+    return {i: by_rid[rid] for i, rid in enumerate(order)}
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from benchmarks.inference.prefix_trace import make_bursty_prefix_trace
+    from examples.serve_router import run_fleet
+
+    from deepspeed_tpu.telemetry.bus import telemetry_bus
+
+    n_requests, max_new, replicas = 12, 8, 2
+    prompts, _meta = make_bursty_prefix_trace(
+        n_requests, block=16, seed=0, num_prefixes=2,
+        prefix_blocks=(4, 2), weights=(0.7, 0.3), suffix_base=9,
+        burst_len=3, vocab=512)
+
+    t0 = time.monotonic()
+    print("== reference: uninterrupted in-process run ==")
+    reference = reference_completions(prompts, max_new)
+
+    print("== baseline: fleet, no kill ==")
+    baseline = run_fleet(prompts, max_new=max_new, replicas=replicas,
+                         kill_replica=None, verbose=False)
+
+    print("== chaos: fleet, kill the most-loaded replica mid-decode ==")
+    events = []
+    telemetry_bus.subscribe(events.append)
+    chaos = run_fleet(prompts, max_new=max_new, replicas=replicas,
+                      kill_replica="auto", kill_after_tokens=6)
+    telemetry_bus.unsubscribe(events.append)
+
+    failures = []
+    migrated = sorted(rid for rid, r in chaos["per_request"].items()
+                      if r["failovers"] > 0)
+    if chaos["killed_replica"] is None:
+        failures.append("the kill never fired — scenario did not run")
+    if not migrated:
+        failures.append("the killed replica had no in-flight requests "
+                        "— the scenario proved nothing")
+
+    # zero lost requests, full budgets
+    for rid in range(n_requests):
+        r = chaos["per_request"].get(rid)
+        toks = chaos["completions"].get(rid, [])
+        if r is None or not r["done"] or r["shed"]:
+            failures.append(f"request {rid} was lost (entry={r})")
+        elif len(toks) != max_new:
+            failures.append(f"request {rid} completed short: "
+                            f"{len(toks)}/{max_new} tokens")
+
+    # token-identical to the uninterrupted reference — baseline AND
+    # chaos, migrated requests included
+    for name, run in (("baseline", baseline), ("chaos", chaos)):
+        for rid, ref in reference.items():
+            got = run["completions"].get(rid)
+            if got != ref:
+                tag = " (migrated)" if (name == "chaos" and
+                                        rid in migrated) else ""
+                failures.append(
+                    f"{name}: request {rid}{tag} diverged from the "
+                    f"reference\n    ref: {ref}\n    got: {got}")
+
+    # exactly one serve.failover per migrated request, one replica_down
+    fo = [e for e in events if e["kind"] == "serve.failover"]
+    fo_rids = sorted(e["request_id"] for e in fo)
+    if fo_rids != migrated:
+        failures.append(f"serve.failover events {fo_rids} != migrated "
+                        f"requests {migrated}")
+    downs = [e for e in events if e["kind"] == "serve.replica_down"]
+    if len(downs) != 1 or downs[0]["replica"] != chaos["killed_replica"]:
+        failures.append(f"expected one serve.replica_down for replica "
+                        f"{chaos['killed_replica']}, got {downs}")
+
+    ttft_all = {rid: r["ttft_s"]
+                for rid, r in chaos["per_request"].items()}
+    result = {
+        "requests": n_requests,
+        "max_new_tokens": max_new,
+        "replicas": replicas,
+        "killed_replica": chaos["killed_replica"],
+        "migrated_requests": migrated,
+        "lost_requests": sum(
+            1 for rid in range(n_requests)
+            if chaos["completions"].get(rid, []) != reference[rid]),
+        "token_identical_replays": not failures,
+        "failover_events": len(fo),
+        "ttft_p95_s": {
+            "baseline_no_kill": _p95(
+                r["ttft_s"] for r in baseline["per_request"].values()),
+            "chaos_with_failover": _p95(ttft_all.values()),
+            "chaos_migrated_only": _p95(
+                ttft_all[rid] for rid in migrated if rid in ttft_all),
+            # counterfactual: same kill, no failover machinery — every
+            # migrated request is lost outright, the survivors' TTFTs
+            # are unchanged (they never saw the extra load)
+            "no_failover_counterfactual": _p95(
+                v for rid, v in ttft_all.items() if rid not in migrated),
+        },
+        "no_failover_lost_requests": len(migrated),
+        "router": chaos["router"],
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+    with open(RESULTS, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    print(f"results -> {RESULTS}")
+
+    if failures:
+        print("\nCHAOS-SERVE FAILURES:")
+        for f_ in failures:
+            print(" -", f_)
+        sys.exit(1)
+    print(f"\nchaos-serve OK: killed replica {chaos['killed_replica']}, "
+          f"{len(migrated)} request(s) migrated and replayed "
+          "token-identically, zero lost")
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,20 @@ class RequestShedError(AdmissionRejected):
         super().__init__(message, reason=reason)
 
 
+class DeadlineExceededError(AdmissionRejected):
+    """The request's deadline expired before it could be served."""
+
+    def __init__(self, message: str):
+        super().__init__(message, reason="deadline")
+
+
+class DrainingError(AdmissionRejected):
+    """Admission is closed: the scheduler is draining (SIGTERM)."""
+
+    def __init__(self, message: str):
+        super().__init__(message, reason="draining")
+
+
 @dataclass
 class Request:
     """One sequence to serve: prompt token ids plus completion rules."""
@@ -85,6 +99,17 @@ class Request:
     # called as callback(request_id, token_id, done) per emitted token
     stream_callback: Optional[Callable[[int, int, bool], None]] = None
     request_id: Optional[int] = None
+    # absolute time.monotonic() by which the FIRST token must be on its
+    # way; an expired request is shed from the queue, never a lane
+    t_deadline: Optional[float] = None
+    # failover replay: tokens this request already emitted on a replica
+    # that died. Admission re-prefills prompt + replay_tokens (prompt at
+    # its original bucket, then continuation_chunk_spans over the
+    # emitted region — identical pad offset and chunk geometry to the
+    # uninterrupted run) and decoding continues under the ORIGINAL
+    # max_new_tokens budget. Greedy decode is a pure function of
+    # (weights, tokens-so-far), so the continuation is token-identical.
+    replay_tokens: Optional[List[int]] = None
 
 
 @dataclass
@@ -167,7 +192,9 @@ class ContinuousBatchingScheduler:
                  max_pending: Optional[int] = None,
                  prefix_cache=None,
                  admission_controller=None,
-                 reject_callback: Optional[Callable] = None):
+                 reject_callback: Optional[Callable] = None,
+                 journal=None,
+                 health_provider=None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_pending is not None and max_pending < 1:
@@ -185,11 +212,21 @@ class ContinuousBatchingScheduler:
         #       (admit, reason), consulted per submit()
         #   reject_callback(request_id, reason) — the 429 hook, invoked
         #       before the typed error is raised
+        #   journal — serving.RequestJournal (record_submit/record_token/
+        #       record_shed), the exact-failover flight record
+        #   health_provider — .states() dict folded into frontdoor_stats
+        #       and the per-iteration serve.stats event
         self.max_pending = None if max_pending is None else int(max_pending)
         self.prefix_cache = prefix_cache
         self.admission_controller = admission_controller
         self.reject_callback = reject_callback
+        self.journal = journal
+        self.health_provider = health_provider
         self.shed_count = 0
+        self.deadline_shed_count = 0
+        self._draining = False
+        self.drain_reason: Optional[str] = None
+        self._lanes_active = 0
         self._mcfg = getattr(engine.module, "config", None)
 
         from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils \
@@ -222,13 +259,25 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               stream_callback: Optional[Callable] = None) -> int:
+               stream_callback: Optional[Callable] = None,
+               deadline_s: Optional[float] = None,
+               replay_tokens: Optional[Sequence[int]] = None) -> int:
         """Queue one request; returns its request id.
 
-        Raises ``QueueFullError`` when the queue is at ``max_pending`` and
-        ``RequestShedError`` when the admission controller sheds — both
-        AdmissionRejected, the 429 surface. The reject callback fires
-        first, so a server can answer the client before the raise unwinds.
+        Raises ``QueueFullError`` when the queue is at ``max_pending``,
+        ``RequestShedError`` when the admission controller sheds,
+        ``DeadlineExceededError`` when ``deadline_s`` is already spent,
+        and ``DrainingError`` once ``begin_drain`` closed admission —
+        all AdmissionRejected, the 429 surface. The reject callback
+        fires first, so a server can answer the client before the raise
+        unwinds.
+
+        ``deadline_s`` is a relative first-token budget: a request still
+        queued when it expires is shed from the queue (never occupying a
+        lane), with a ``serve.deadline_shed`` event. ``replay_tokens``
+        marks a failover replay (see ``Request.replay_tokens``): the
+        stream callback fires only for NEW tokens — the client already
+        holds the replayed prefix.
         """
         prompt = list(int(t) for t in prompt)
         if not prompt:
@@ -236,7 +285,23 @@ class ContinuousBatchingScheduler:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        replay = [int(t) for t in replay_tokens] if replay_tokens else []
+        if replay and len(replay) >= max_new_tokens:
+            raise ValueError(
+                f"replay of {len(replay)} tokens exhausts the "
+                f"max_new_tokens budget ({max_new_tokens}) — the request "
+                "already finished; do not replay it")
         depth = len(self._pending)
+        if self._draining:
+            self._reject(DrainingError(
+                "admission is closed: the scheduler is draining "
+                f"({self.drain_reason})"), depth)
+        if deadline_s is not None and deadline_s <= 0:
+            from deepspeed_tpu.telemetry.bus import KIND_SERVE_DEADLINE_SHED
+
+            self._reject(DeadlineExceededError(
+                f"deadline_s={deadline_s} already expired at submit"),
+                depth, kind=KIND_SERVE_DEADLINE_SHED)
         if self.max_pending is not None and depth >= self.max_pending:
             self._reject(QueueFullError(
                 f"admission queue is full ({depth}/{self.max_pending} "
@@ -256,26 +321,80 @@ class ContinuousBatchingScheduler:
                 f"(n_positions={self._max_pos})")
         rid = self._next_id
         self._next_id += 1
+        now = time.monotonic()
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       eos_token_id=(self.eos_token_id if eos_token_id is None
                                     else eos_token_id),
-                      stream_callback=stream_callback, request_id=rid)
-        self._pending.append((req, time.monotonic()))
+                      stream_callback=stream_callback, request_id=rid,
+                      t_deadline=(None if deadline_s is None
+                                  else now + float(deadline_s)),
+                      replay_tokens=replay or None)
+        if self.journal is not None:
+            self.journal.record_submit(
+                rid, prompt, req.max_new_tokens,
+                deadline=req.t_deadline, emitted=replay)
+        self._pending.append((req, now))
         return rid
 
-    def _reject(self, exc: AdmissionRejected, depth: int):
-        """Publish serve.shed, fire the 429 callback, raise ``exc``."""
+    def _reject(self, exc: AdmissionRejected, depth: int, kind=None):
+        """Publish serve.shed (or ``kind``), fire the 429 callback,
+        raise ``exc``."""
         from deepspeed_tpu.telemetry.bus import KIND_SERVE_SHED, publish
 
         self.shed_count += 1
-        publish(KIND_SERVE_SHED, severity="warning", reason=exc.reason,
-                queue_depth=depth, shed_total=self.shed_count)
+        if isinstance(exc, DeadlineExceededError):
+            self.deadline_shed_count += 1
+        publish(kind or KIND_SERVE_SHED, severity="warning",
+                reason=exc.reason, queue_depth=depth,
+                shed_total=self.shed_count)
         if self.reject_callback is not None:
             try:
                 self.reject_callback(None, exc.reason)
             except Exception:  # the callback must not mask the rejection
                 pass
         raise exc
+
+    def begin_drain(self, reason: str = "drain") -> None:
+        """Close admission (SIGTERM posture). Safe from a signal
+        handler: sets a flag, publishes one ``serve.drain``, touches no
+        jax state. ``run()`` finishes the lanes already decoding and
+        returns with the queue intact for journal hand-off."""
+        if self._draining:
+            return
+        from deepspeed_tpu.telemetry.bus import KIND_SERVE_DRAIN, publish
+
+        self._draining = True
+        self.drain_reason = str(reason)
+        publish(KIND_SERVE_DRAIN, severity="warning", phase="begin",
+                reason=self.drain_reason, queue_depth=len(self._pending),
+                lanes_active=self._lanes_active)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _shed_expired(self, req: Request, t_submit: float) -> None:
+        """Drop one queue entry whose deadline passed before a lane
+        freed up — it never occupies a lane or runs a prefill."""
+        from deepspeed_tpu.telemetry.bus import (
+            KIND_SERVE_DEADLINE_SHED,
+            publish,
+        )
+
+        now = time.monotonic()
+        self.deadline_shed_count += 1
+        publish(KIND_SERVE_DEADLINE_SHED, severity="warning",
+                request_id=req.request_id, waited_s=now - t_submit,
+                late_s=now - req.t_deadline,
+                queue_depth=len(self._pending),
+                deadline_shed_total=self.deadline_shed_count)
+        if self.journal is not None:
+            self.journal.record_shed(req.request_id)
+        if self.reject_callback is not None:
+            try:
+                self.reject_callback(req.request_id, "deadline")
+            except Exception:
+                pass
 
     def _bucketed_len(self, n: int) -> int:
         b = self.prompt_bucket
@@ -376,6 +495,19 @@ class ContinuousBatchingScheduler:
         else:
             logits_last, sub_cache = eng._chunked_prefill(
                 jnp.asarray(ids), jnp.asarray(mask))
+        if req.replay_tokens:
+            # failover replay: re-run the emitted tokens as a chunked
+            # CONTINUATION prefill starting at the original bucket Lp —
+            # identical pad offset and chunk geometry to the
+            # uninterrupted run, so the cache state (and every logit
+            # after it) is bit-identical to the run that died
+            E = len(req.replay_tokens)
+            rep_ids = np.asarray([req.replay_tokens], np.int32)
+            rep_mask = np.ones((1, E), bool)
+            for s, e in continuation_chunk_spans(self._mcfg, Lp, Lp + E):
+                logits_last, sub_cache = eng._prefill_more_fn(
+                    eng._params, jnp.asarray(rep_ids[:, s - Lp:e - Lp]),
+                    jnp.asarray(rep_mask[:, s - Lp:e - Lp]), sub_cache)
         eng._rng, sub = jax.random.split(eng._rng)
         if self.temperature > 0:
             tok = jax.random.categorical(
@@ -443,19 +575,62 @@ class ContinuousBatchingScheduler:
         return logits_last, cache
 
     def frontdoor_stats(self) -> Dict[str, Any]:
-        """Shed + prefix-cache counters for benches and servers."""
+        """Shed + prefix-cache + health counters for benches/servers."""
         out: Dict[str, Any] = {"shed": self.shed_count,
-                               "pending": len(self._pending)}
+                               "deadline_shed": self.deadline_shed_count,
+                               "pending": len(self._pending),
+                               "lanes_active": self._lanes_active,
+                               "draining": self._draining}
         if self.prefix_cache is not None:
             out["prefix"] = self.prefix_cache.stats()
         if self.admission_controller is not None and \
                 hasattr(self.admission_controller, "stats"):
             out["admission"] = self.admission_controller.stats()
+        if self.journal is not None and hasattr(self.journal, "stats"):
+            out["journal"] = self.journal.stats()
+        if self.health_provider is not None and \
+                hasattr(self.health_provider, "states"):
+            out["health"] = dict(self.health_provider.states())
         return out
 
+    def _publish_stats(self, stats: "ServingStats", lanes) -> None:
+        """One ``serve.stats`` snapshot per scheduler iteration — queue
+        depth, lane occupancy, shed counters, prefix hit-rate and fleet
+        health, so dashboards see front-door pressure without polling."""
+        from deepspeed_tpu.telemetry.bus import KIND_SERVE_STATS, publish
+
+        self._lanes_active = sum(1 for l in lanes if l is not None)
+        payload: Dict[str, Any] = {
+            "queue_depth": len(self._pending),
+            "lanes_active": self._lanes_active,
+            "shed": self.shed_count,
+            "deadline_shed": self.deadline_shed_count,
+            "decode_steps": stats.decode_steps,
+            "draining": self._draining,
+        }
+        if self.prefix_cache is not None:
+            payload["prefix_hit_rate"] = \
+                self.prefix_cache.stats().get("hit_rate", 0.0)
+        if self.health_provider is not None and \
+                hasattr(self.health_provider, "states"):
+            payload["health"] = dict(self.health_provider.states())
+        publish(KIND_SERVE_STATS, **payload)
+
     # ------------------------------------------------------------------
-    def run(self) -> ServingStats:
-        """Serve the queue to completion; returns stats + completions."""
+    def run(self, poll_fn: Optional[Callable[[], None]] = None
+            ) -> ServingStats:
+        """Serve the queue to completion; returns stats + completions.
+
+        ``poll_fn`` (optional) is called once per loop iteration, between
+        decode steps — the hook a fleet replica uses to pump its control
+        pipe, so failover replays submitted mid-run land in free lanes
+        without waiting for this run to finish.
+
+        While draining (``begin_drain``): no new admissions, lanes
+        already decoding finish normally, and the loop exits with the
+        pending queue INTACT — the caller hands those (and nothing else)
+        off via the journal.
+        """
         self._ensure_compiled()
         eng = self.engine
         stats = ServingStats()
@@ -486,41 +661,66 @@ class ContinuousBatchingScheduler:
             now = time.monotonic()
             lane.comp.tokens.append(token)
             lane.emitted += 1
-            if lane.emitted == 1:
+            if lane.comp.t_first_token == 0.0:
                 lane.comp.t_first_token = now
-                publish(KIND_SERVE_FIRST_TOKEN,
-                        request_id=lane.req.request_id, lane=lane_no,
-                        ttft_s=now - lane.comp.t_submit)
+                # replays do not republish serve.first_token: the client
+                # saw its first token on the replica that died, and a
+                # replay-time sample would bias the admission p95 window
+                if lane.req.replay_tokens is None:
+                    publish(KIND_SERVE_FIRST_TOKEN,
+                            request_id=lane.req.request_id, lane=lane_no,
+                            ttft_s=now - lane.comp.t_submit)
             done = (lane.emitted >= lane.req.max_new_tokens
                     or (lane.req.eos_token_id is not None
                         and token == lane.req.eos_token_id))
+            if self.journal is not None:
+                self.journal.record_token(
+                    lane.req.request_id, token, done=done)
             if lane.req.stream_callback is not None:
                 lane.req.stream_callback(lane.req.request_id, token, done)
             return done
 
-        while self._pending or any(l is not None for l in lanes):
+        while True:
+            if poll_fn is not None:
+                poll_fn()
+            active = any(l is not None for l in lanes)
+            if self._draining:
+                if not active:
+                    break  # queue left intact for journal hand-off
+            elif not (self._pending or active):
+                break
             # admissions: fill every free lane from the queue. A request
             # that completes AT admission (max_new 1, or first token is
             # EOS) frees its lane for the next pending request immediately.
-            for lane_no in range(self.slots):
+            # An expired deadline sheds here — before the prefill, so a
+            # doomed request never occupies a lane. Draining admits none.
+            for lane_no in range(self.slots if not self._draining else 0):
                 while lanes[lane_no] is None and self._pending:
                     req, t_submit = self._pending.popleft()
-                    comp = Completion(request_id=req.request_id, tokens=[],
+                    if req.t_deadline is not None and \
+                            time.monotonic() > req.t_deadline:
+                        self._shed_expired(req, t_submit)
+                        continue
+                    replayed = len(req.replay_tokens or ())
+                    comp = Completion(request_id=req.request_id,
+                                      tokens=list(req.replay_tokens or ()),
                                       prompt_len=len(req.prompt),
                                       t_submit=t_submit)
                     comp.t_admit = time.monotonic()
                     publish(KIND_SERVE_ADMIT, request_id=req.request_id,
                             lane=lane_no, prompt_len=len(req.prompt),
+                            replayed=replayed,
                             queue_wait_s=comp.t_admit - t_submit,
                             queue_depth=len(self._pending))
                     first_tok, sub_cache = self._admit_prefill(req)
                     cache = self._splice(cache, sub_cache, lane_no)
                     tok[lane_no] = first_tok
-                    lane = _Lane(req=req, comp=comp)
+                    lane = _Lane(req=req, comp=comp, emitted=replayed)
                     lanes[lane_no] = lane
                     if emit(lane_no, lane, first_tok):
                         finish(lane_no, lane)
 
+            self._publish_stats(stats, lanes)
             if not any(l is not None for l in lanes):
                 continue  # everything admitted finished at token 1
 

@@ -8,7 +8,10 @@ Three layers over ``inference/scheduler.py``'s continuous batching:
 * :class:`SLOAdmissionController` — telemetry-bus-driven load shedding
   that holds a p95 TTFT SLO with a bounded queue;
 * :class:`PrefixRouter` — hash-affine, depth-balanced placement across
-  replicas (``examples/serve_router.py`` runs it for real).
+  replicas (``examples/serve_router.py`` runs it for real);
+* :mod:`fleet` — replica health, request journaling, exact failover
+  replay, and graceful drain (the fault-tolerance layer over all of
+  the above).
 
 ``build_serving`` is the config-plumbing entry point — the serving
 analogue of ``deepspeed_tpu.initialize(config=...)``.
@@ -19,6 +22,8 @@ from typing import Any, Dict, Optional
 from deepspeed_tpu.inference.scheduler import (
     AdmissionRejected,
     ContinuousBatchingScheduler,
+    DeadlineExceededError,
+    DrainingError,
     QueueFullError,
     RequestShedError,
 )
@@ -26,22 +31,53 @@ from deepspeed_tpu.serving.admission import (
     AdmissionConfig,
     SLOAdmissionController,
 )
+from deepspeed_tpu.serving.fleet import (
+    DOWN,
+    HEALTHY,
+    RECOVERING,
+    SUSPECT,
+    FleetCoordinator,
+    FleetHealth,
+    GracefulDrain,
+    HealthConfig,
+    JournalEntry,
+    ReplicaDead,
+    RequestJournal,
+)
 from deepspeed_tpu.serving.prefix_cache import (
     PrefixCache,
     PrefixCacheConfig,
 )
-from deepspeed_tpu.serving.router import PrefixRouter, route_trace
+from deepspeed_tpu.serving.router import (
+    NoLiveReplicasError,
+    PrefixRouter,
+    route_trace,
+)
 
 __all__ = [
     "AdmissionConfig",
     "AdmissionRejected",
     "ContinuousBatchingScheduler",
+    "DOWN",
+    "DeadlineExceededError",
+    "DrainingError",
+    "FleetCoordinator",
+    "FleetHealth",
+    "GracefulDrain",
+    "HEALTHY",
+    "HealthConfig",
+    "JournalEntry",
+    "NoLiveReplicasError",
     "PrefixCache",
     "PrefixCacheConfig",
     "PrefixRouter",
     "QueueFullError",
+    "RECOVERING",
+    "ReplicaDead",
+    "RequestJournal",
     "RequestShedError",
     "SLOAdmissionController",
+    "SUSPECT",
     "build_serving",
     "route_trace",
 ]
@@ -72,11 +108,13 @@ def build_serving(engine, config: Optional[Dict[str, Any]] = None,
             "prefix_cache": {"promote_after": 2,
                              "budget_bytes": 512 << 20},
             "admission": {"slo_ttft_p95_s": 2.0, "window": 64},
+            "journal": True,
         })
 
-    ``prefix_cache``/``admission`` accept a knob dict, ``True`` (all
-    defaults), or ``False``/absent (off). Unknown keys raise — a typo'd
-    knob silently running with defaults is how SLOs get missed.
+    ``prefix_cache``/``admission``/``journal`` accept a knob dict,
+    ``True`` (all defaults), or ``False``/absent (off). Unknown keys
+    raise — a typo'd knob silently running with defaults is how SLOs
+    get missed.
     """
     cfg = dict(config or {})
     slots = int(cfg.pop("slots", 8))
@@ -86,6 +124,7 @@ def build_serving(engine, config: Optional[Dict[str, Any]] = None,
     max_pending = cfg.pop("max_pending", None)
     pc_cfg = cfg.pop("prefix_cache", False)
     adm_cfg = cfg.pop("admission", False)
+    journal_cfg = cfg.pop("journal", False)
     if cfg:
         raise ValueError(f"unknown serving config keys: {sorted(cfg)}")
 
@@ -100,8 +139,14 @@ def build_serving(engine, config: Optional[Dict[str, Any]] = None,
         knobs = dict(adm_cfg) if isinstance(adm_cfg, dict) else {}
         admission = SLOAdmissionController(AdmissionConfig(**knobs))
 
+    journal = None
+    if journal_cfg:
+        knobs = dict(journal_cfg) if isinstance(journal_cfg, dict) else {}
+        journal = RequestJournal(**knobs)
+
     return ContinuousBatchingScheduler(
         engine, slots=slots, prompt_bucket=prompt_bucket,
         temperature=temperature, eos_token_id=eos_token_id,
         max_pending=max_pending, prefix_cache=prefix_cache,
-        admission_controller=admission, reject_callback=reject_callback)
+        admission_controller=admission, reject_callback=reject_callback,
+        journal=journal)

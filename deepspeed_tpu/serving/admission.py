@@ -51,6 +51,11 @@ class AdmissionConfig:
     drain_to: Optional[int] = None  # ... AND queue <= this (default: slots)
     starvation_grace_s: float = 2.0  # how long a prefetch-starved signal
                                      # counts as live backpressure
+    sample_max_age_s: Optional[float] = 30.0  # TTFT samples older than
+    # this are evidence of a past era, not the present: without aging,
+    # the first arrivals after an idle period would be judged (and shed)
+    # on breach-era p95 evidence that no longer describes the replica.
+    # None disables aging (count-bounded window only).
 
     def __post_init__(self):
         if self.slo_ttft_p95_s <= 0:
@@ -59,6 +64,8 @@ class AdmissionConfig:
             raise ValueError("recover_frac must be in (0, 1]")
         if self.min_samples < 1 or self.window < self.min_samples:
             raise ValueError("need window >= min_samples >= 1")
+        if self.sample_max_age_s is not None and self.sample_max_age_s <= 0:
+            raise ValueError("sample_max_age_s must be positive (or None)")
 
 
 class SLOAdmissionController:
@@ -80,14 +87,25 @@ class SLOAdmissionController:
     def on_event(self, ev: Dict[str, Any]) -> None:
         kind = ev.get("kind")
         if kind == KIND_SERVE_FIRST_TOKEN and "ttft_s" in ev:
-            self._ttfts.append(float(ev["ttft_s"]))
+            # samples carry their arrival time so an idle gap ages the
+            # whole window out instead of freezing breach-era evidence
+            self._ttfts.append((self._clock(), float(ev["ttft_s"])))
         elif kind == KIND_PREFETCH_STARVED:
             self._last_starved = self._clock()
 
+    def _prune_stale(self) -> None:
+        max_age = self.config.sample_max_age_s
+        if max_age is None:
+            return
+        horizon = self._clock() - max_age
+        while self._ttfts and self._ttfts[0][0] < horizon:
+            self._ttfts.popleft()
+
     def p95_ttft(self) -> Optional[float]:
+        self._prune_stale()
         if len(self._ttfts) < self.config.min_samples:
             return None
-        xs = sorted(self._ttfts)
+        xs = sorted(v for _, v in self._ttfts)
         return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
 
     def _input_starved(self) -> bool:

@@ -1,0 +1,506 @@
+"""Fleet fault tolerance: replica health, in-flight journaling, exact
+failover replay, and graceful drain.
+
+ROADMAP item 2 multiplies serving replicas, which multiplies the chance
+that *some* replica is dead at any moment — production TPU serving
+treats replica preemption as routine, not exceptional. Before this
+module the front door had zero posture for it: ``PrefixRouter`` kept
+routing to a crashed replica forever, and a replica kill silently lost
+every lane it was decoding. The training side earned its fault
+tolerance in PRs 1/5/16 (manifest-verified checkpoints, sentinel
+rollback, elastic topology resume); this is the serving analogue, built
+from four pieces that compose into the repo's first cross-process
+control loop:
+
+* :class:`FleetHealth` — a heartbeat-driven per-replica state machine
+  (``healthy → suspect → down → recovering → healthy``). Any message
+  from a replica is a heartbeat; silence degrades the state on a
+  configured schedule, and a pipe EOF (the unambiguous signal) jumps
+  straight to ``down``. ``serve.replica_down`` / ``serve.replica_up``
+  telemetry fires on the down/up edges only. Routing consults
+  ``live()``: a ``down`` replica receives nothing, and a recovered one
+  gets its hash-affine homes back automatically (re-affinity is free
+  because the home mapping is a pure hash — only the live mask changes).
+
+* :class:`RequestJournal` — the per-request flight record: prompt,
+  every token *delivered to the client*, assigned replica, deadline.
+  This is what makes failover **exact**: greedy decode is a pure
+  function of (weights, prompt-so-far), so a survivor that re-prefills
+  ``prompt + emitted`` and keeps decoding MUST produce the same
+  continuation the dead replica would have (the scheduler replays via
+  ``continuation_chunk_spans`` at the original pad offset, so even the
+  chunk geometry matches — see ``replay_tokens`` in
+  ``ContinuousBatchingScheduler.submit``). Tokens that a dying replica
+  generated but never got onto the wire are *not* in the journal — and
+  that is the correct cut: the client never saw them, and the replay
+  regenerates them token-identically.
+
+* :class:`FleetCoordinator` — the front-door composition: routes with
+  the live mask and journal-derived queue depths, journals every
+  placement and token, and on a replica death turns that replica's
+  in-flight entries into replay assignments on survivors — exactly one
+  ``serve.failover`` event per migrated request.
+
+* :class:`GracefulDrain` — SIGTERM posture for one serving process:
+  admission closes (``DrainingError``), in-flight lanes finish, queued
+  requests are handed off as journal replay specs, and the flight
+  recorder's signal-time blackbox is retracted on clean completion
+  (reusing PR 10's ``retract_dump`` — a drained exit is not a crash).
+
+Like ``admission.py``, everything here is policy: no jax, no process
+spawning. ``examples/serve_router.py`` wires it to real replica
+processes over pipes (and ``benchmarks/inference/chaos_serve.py`` kills
+one mid-decode to prove the exactness claim end to end).
+"""
+
+import signal as signal_module
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.telemetry.bus import (
+    KIND_SERVE_DRAIN,
+    KIND_SERVE_FAILOVER,
+    KIND_SERVE_REPLICA_DOWN,
+    KIND_SERVE_REPLICA_UP,
+    telemetry_bus,
+)
+
+# Replica health states (the full cycle: healthy -> suspect -> down ->
+# recovering -> healthy; heartbeats move left, silence moves right)
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+DOWN = "down"
+RECOVERING = "recovering"
+
+
+class ReplicaDead(RuntimeError):
+    """A replica's pipe hit EOF / its process died (raised by transport
+    helpers in the example and bench; carries the replica index)."""
+
+    def __init__(self, replica: int, message: str = ""):
+        super().__init__(message or f"replica {replica} is dead")
+        self.replica = int(replica)
+
+
+@dataclass
+class HealthConfig:
+    suspect_after_s: float = 2.0   # silence before healthy -> suspect
+    down_after_s: float = 6.0      # silence before (any live) -> down
+    recover_probes: int = 2        # heartbeats to go recovering -> healthy
+
+    def __post_init__(self):
+        if not 0 < self.suspect_after_s < self.down_after_s:
+            raise ValueError(
+                "need 0 < suspect_after_s < down_after_s, got "
+                f"{self.suspect_after_s} / {self.down_after_s}")
+        if self.recover_probes < 1:
+            raise ValueError(
+                f"recover_probes must be >= 1, got {self.recover_probes}")
+
+
+class FleetHealth:
+    """Heartbeat-driven replica health; see module docstring.
+
+    ``heartbeat(i)`` on every message from replica ``i``; ``sweep()``
+    before every routing decision (time drives the degradations);
+    ``mark_down(i)`` when the transport says so (EOF beats any timer).
+    Thread-safe: the demo pumps replica pipes from one thread, but
+    signal handlers and tests poke it from others.
+    """
+
+    def __init__(self, n_replicas: int, config: Optional[HealthConfig] = None,
+                 clock: Callable[[], float] = time.monotonic, bus=None):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.n_replicas = int(n_replicas)
+        self.config = config or HealthConfig()
+        self._clock = clock
+        self._bus = bus if bus is not None else telemetry_bus
+        self._lock = threading.Lock()
+        now = self._clock()
+        self._state = [HEALTHY] * self.n_replicas
+        self._last_beat = [now] * self.n_replicas
+        self._probes = [0] * self.n_replicas
+        # (ts, replica, from, to) — bounded by the number of real
+        # transitions, which is tiny; tests and the demo read it
+        self.transitions: List[Tuple[float, int, str, str]] = []
+
+    def _set(self, i: int, to: str, reason: str) -> None:
+        """Caller holds the lock. Publishes only on the down/up edges."""
+        frm = self._state[i]
+        if frm == to:
+            return
+        self._state[i] = to
+        self.transitions.append((self._clock(), i, frm, to))
+        if to == DOWN:
+            self._bus.publish(KIND_SERVE_REPLICA_DOWN, severity="warning",
+                              replica=i, previous=frm, reason=reason)
+        elif to == HEALTHY and frm in (RECOVERING, DOWN):
+            self._bus.publish(KIND_SERVE_REPLICA_UP, replica=i,
+                              probes=self._probes[i])
+
+    def heartbeat(self, i: int) -> str:
+        """Replica ``i`` showed a sign of life; returns its new state."""
+        with self._lock:
+            self._last_beat[i] = self._clock()
+            st = self._state[i]
+            if st == DOWN:
+                self._probes[i] = 1
+                if self.config.recover_probes <= 1:
+                    self._set(i, HEALTHY, "recovered")
+                else:
+                    self._set(i, RECOVERING, "heartbeat")
+            elif st == RECOVERING:
+                self._probes[i] += 1
+                if self._probes[i] >= self.config.recover_probes:
+                    self._set(i, HEALTHY, "recovered")
+            elif st == SUSPECT:
+                self._set(i, HEALTHY, "heartbeat")
+            return self._state[i]
+
+    def sweep(self) -> None:
+        """Apply the silence schedule to every replica."""
+        with self._lock:
+            now = self._clock()
+            for i in range(self.n_replicas):
+                st = self._state[i]
+                if st == DOWN:
+                    continue
+                silence = now - self._last_beat[i]
+                if silence >= self.config.down_after_s:
+                    self._probes[i] = 0
+                    self._set(i, DOWN, f"silent {silence:.1f}s")
+                elif st == HEALTHY and \
+                        silence >= self.config.suspect_after_s:
+                    self._set(i, SUSPECT, "silence")
+
+    def mark_down(self, i: int, reason: str = "reported") -> None:
+        """Unambiguous death (pipe EOF, waitpid): skip the timers."""
+        with self._lock:
+            self._probes[i] = 0
+            self._set(i, DOWN, reason)
+
+    def state(self, i: int) -> str:
+        with self._lock:
+            return self._state[i]
+
+    def states(self) -> Dict[int, str]:
+        with self._lock:
+            return {i: s for i, s in enumerate(self._state)}
+
+    def live(self) -> List[bool]:
+        """The routing mask: everything except ``down`` is routable —
+        ``suspect`` keeps its traffic (it may just be slow) and
+        ``recovering`` gets its homes back (re-affinity)."""
+        with self._lock:
+            return [s != DOWN for s in self._state]
+
+    def n_live(self) -> int:
+        return sum(self.live())
+
+
+# ---------------------------------------------------------------------
+@dataclass
+class JournalEntry:
+    """One request's flight record. ``emitted`` holds every token that
+    reached the client, in order — the replay prefix."""
+    request_id: Any
+    prompt: List[int]
+    max_new_tokens: int
+    emitted: List[int] = field(default_factory=list)
+    replica: Optional[int] = None
+    deadline: Optional[float] = None  # absolute, caller's clock domain
+    done: bool = False
+    shed: bool = False
+    failovers: int = 0
+    t_submit: float = 0.0
+    t_first_token: Optional[float] = None
+
+    @property
+    def remaining_tokens(self) -> int:
+        return self.max_new_tokens - len(self.emitted)
+
+
+class RequestJournal:
+    """Per-request prompt + delivered-token record; see module docstring.
+
+    ``retain_done=False`` drops finished entries immediately (the
+    long-lived-server setting); the default keeps them so benches and
+    tests can audit full completions.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 retain_done: bool = True):
+        self._clock = clock
+        self._retain_done = bool(retain_done)
+        self._lock = threading.Lock()
+        self._entries: Dict[Any, JournalEntry] = {}
+        self.completed = 0
+        self.shed_count = 0
+        self.failover_count = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def record_submit(self, request_id, prompt: Sequence[int],
+                      max_new_tokens: int, replica: Optional[int] = None,
+                      deadline: Optional[float] = None,
+                      emitted: Sequence[int] = ()) -> JournalEntry:
+        """A replayed request re-enters with its ``emitted`` prefix."""
+        e = JournalEntry(
+            request_id=request_id, prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            emitted=[int(t) for t in emitted], replica=replica,
+            deadline=deadline, t_submit=self._clock())
+        with self._lock:
+            if request_id in self._entries:
+                raise ValueError(f"request {request_id!r} already journaled")
+            self._entries[request_id] = e
+        return e
+
+    def record_token(self, request_id, token: int,
+                     done: bool = False) -> None:
+        """Append one DELIVERED token; unknown ids are tolerated (a
+        completion racing a failover must not crash the pump)."""
+        with self._lock:
+            e = self._entries.get(request_id)
+            if e is None or e.done:
+                return
+            e.emitted.append(int(token))
+            if e.t_first_token is None:
+                e.t_first_token = self._clock()
+            if done:
+                self._finish(e)
+
+    def record_done(self, request_id) -> None:
+        with self._lock:
+            e = self._entries.get(request_id)
+            if e is not None and not e.done:
+                self._finish(e)
+
+    def record_shed(self, request_id) -> None:
+        """The request was intentionally dropped (deadline, drain)."""
+        with self._lock:
+            e = self._entries.get(request_id)
+            if e is None or e.done:
+                return
+            e.shed = True
+            self.shed_count += 1
+            self._finish(e, completed=False)
+
+    def _finish(self, e: JournalEntry, completed: bool = True) -> None:
+        e.done = True
+        if completed:
+            self.completed += 1
+        if not self._retain_done:
+            self._entries.pop(e.request_id, None)
+
+    def reassign(self, request_id, replica: int) -> JournalEntry:
+        with self._lock:
+            e = self._entries[request_id]
+            e.replica = int(replica)
+            e.failovers += 1
+            self.failover_count += 1
+            return e
+
+    def entry(self, request_id) -> Optional[JournalEntry]:
+        with self._lock:
+            return self._entries.get(request_id)
+
+    def inflight(self, replica: Optional[int] = None) -> List[JournalEntry]:
+        """Open entries, oldest first (insertion order), optionally for
+        one replica — the failover work list."""
+        with self._lock:
+            return [e for e in self._entries.values()
+                    if not e.done and
+                    (replica is None or e.replica == replica)]
+
+    def depths(self, n_replicas: int) -> List[int]:
+        """Journal-derived queue depth per replica — the router's load
+        signal without a cross-process round trip."""
+        out = [0] * int(n_replicas)
+        with self._lock:
+            for e in self._entries.values():
+                if not e.done and e.replica is not None and \
+                        0 <= e.replica < len(out):
+                    out[e.replica] += 1
+        return out
+
+    def replay_spec(self, request_id) -> Dict[str, Any]:
+        """The exact-replay recipe for one in-flight request: re-prefill
+        ``prompt`` (+ ``replay_tokens`` via continuation spans) and keep
+        decoding under the ORIGINAL token budget."""
+        with self._lock:
+            e = self._entries[request_id]
+            if e.done:
+                raise ValueError(
+                    f"request {request_id!r} already finished — "
+                    "nothing to replay")
+            if e.remaining_tokens < 1:
+                raise ValueError(
+                    f"request {request_id!r} has no token budget left")
+            return {"prompt": list(e.prompt),
+                    "replay_tokens": list(e.emitted),
+                    "max_new_tokens": e.max_new_tokens,
+                    "deadline": e.deadline}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            inflight = sum(1 for e in self._entries.values() if not e.done)
+        return {"inflight": inflight, "completed": self.completed,
+                "shed": self.shed_count, "failovers": self.failover_count}
+
+
+# ---------------------------------------------------------------------
+class FleetCoordinator:
+    """Health-aware routing + journaling + failover for one front door.
+
+    The owner pumps replica transports and calls: ``place`` per arriving
+    request, ``on_token`` per delivered token (heartbeating separately
+    via ``health.heartbeat``), and ``replica_dead`` on EOF — which
+    returns the migrated work as ``(request_id, new_replica, spec)``
+    triples, publishing exactly one ``serve.failover`` each.
+    """
+
+    def __init__(self, router, health: Optional[FleetHealth] = None,
+                 journal: Optional[RequestJournal] = None,
+                 clock: Callable[[], float] = time.monotonic, bus=None):
+        self.router = router
+        self._clock = clock
+        self._bus = bus if bus is not None else telemetry_bus
+        self.health = health if health is not None else FleetHealth(
+            router.n_replicas, clock=clock, bus=self._bus)
+        self.journal = journal if journal is not None else \
+            RequestJournal(clock=clock)
+
+    def place(self, request_id, prompt: Sequence[int], max_new_tokens: int,
+              deadline_s: Optional[float] = None) -> Tuple[int, str]:
+        """Route one request over live replicas and journal it; returns
+        ``(replica, 'affine'|'spill'|'failover')``."""
+        self.health.sweep()
+        depths = self.journal.depths(self.router.n_replicas)
+        replica, how = self.router.route(prompt, depths,
+                                         live=self.health.live())
+        deadline = None if deadline_s is None else \
+            self._clock() + float(deadline_s)
+        self.journal.record_submit(request_id, prompt, max_new_tokens,
+                                   replica=replica, deadline=deadline)
+        return replica, how
+
+    def on_token(self, request_id, token: int, done: bool = False) -> None:
+        self.journal.record_token(request_id, token, done=done)
+
+    def replica_dead(self, replica: int, reason: str = "eof"
+                     ) -> List[Tuple[Any, int, Dict[str, Any]]]:
+        """Mark ``replica`` down and migrate its in-flight requests.
+
+        Each migrated request is re-routed over the survivors (its home
+        hash is unchanged, so the router's failover branch picks the
+        shallowest live replica), reassigned in the journal, and
+        announced with ONE ``serve.failover`` event. Raises
+        ``NoLiveReplicasError`` when nobody is left to take the work.
+        """
+        self.health.mark_down(replica, reason=reason)
+        moved: List[Tuple[Any, int, Dict[str, Any]]] = []
+        for e in self.journal.inflight(replica=replica):
+            spec = self.journal.replay_spec(e.request_id)
+            depths = self.journal.depths(self.router.n_replicas)
+            target, _how = self.router.route(e.prompt, depths,
+                                             live=self.health.live())
+            self.journal.reassign(e.request_id, target)
+            self._bus.publish(
+                KIND_SERVE_FAILOVER, severity="warning",
+                request_id=e.request_id, from_replica=replica,
+                to_replica=target, emitted=len(spec["replay_tokens"]),
+                remaining=spec["max_new_tokens"] -
+                len(spec["replay_tokens"]), reason=reason)
+            moved.append((e.request_id, target, spec))
+        return moved
+
+    def stats(self) -> Dict[str, Any]:
+        return {"health": {str(k): v for k, v in
+                           self.health.states().items()},
+                "journal": self.journal.stats(),
+                "router": self.router.stats()}
+
+
+# ---------------------------------------------------------------------
+class GracefulDrain:
+    """SIGTERM -> close admission, finish lanes, hand off the queue.
+
+    ``install()`` chains a signal handler that calls the scheduler's
+    ``begin_drain()`` — from that instant ``submit()`` raises
+    ``DrainingError`` and ``run()`` stops admitting, finishing only the
+    lanes already decoding. After ``run()`` returns, ``complete()``
+    turns the still-queued requests into journal replay specs (the
+    hand-off artifact for whoever restarts the replica), retracts the
+    flight recorder's signal-time blackbox (a drained exit is a clean
+    exit, not a crash), and publishes the terminal ``serve.drain``.
+    """
+
+    def __init__(self, scheduler, recorder=None, bus=None):
+        self.scheduler = scheduler
+        self.recorder = recorder
+        self._bus = bus if bus is not None else telemetry_bus
+        self.drained = False
+
+    def install(self, signals=("SIGTERM",)) -> Callable[[], None]:
+        """Chain drain triggers onto ``signals`` (main thread only — the
+        ``signal`` module's rule, same guard as the crash handlers).
+        Returns an ``uninstall()`` restoring what was replaced."""
+        restorers: List[Callable[[], None]] = []
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        for name in signals:
+            signum = getattr(signal_module, str(name), None)
+            if signum is None:
+                continue
+            prev = signal_module.getsignal(signum)
+
+            def _handler(sig, frame, _name=str(name), _prev=prev):
+                self.scheduler.begin_drain(reason=f"signal:{_name}")
+                if callable(_prev):
+                    _prev(sig, frame)
+
+            signal_module.signal(signum, _handler)
+
+            def _restore(snum=signum, h=_handler, p=prev):
+                if signal_module.getsignal(snum) is h:
+                    try:
+                        signal_module.signal(snum, p)
+                    except (ValueError, TypeError):
+                        pass
+
+            restorers.append(_restore)
+
+        def uninstall():
+            for r in restorers:
+                r()
+
+        return uninstall
+
+    def complete(self) -> List[Dict[str, Any]]:
+        """Call after ``run()`` returns under a drain; returns the
+        replay specs for every request that never reached a lane."""
+        sched = self.scheduler
+        handoff: List[Dict[str, Any]] = []
+        journal = getattr(sched, "journal", None)
+        if journal is not None:
+            for e in journal.inflight():
+                try:
+                    handoff.append(journal.replay_spec(e.request_id))
+                except ValueError:
+                    continue
+        if self.recorder is not None:
+            # the SIGTERM crash handler dumped a blackbox at signal time
+            # (nobody knew then whether the drain would finish); it did,
+            # so that dump is stale evidence — retract it (PR 10)
+            self.recorder.retract_dump()
+        self._bus.publish(KIND_SERVE_DRAIN, phase="complete",
+                          handed_off=len(handoff),
+                          clean=not getattr(sched, "_lanes_active", 0))
+        self.drained = True
+        return handoff

@@ -10,14 +10,18 @@ wins unless its reported queue depth exceeds the cluster minimum by
 more than ``spill_slack``, in which case the request goes to the
 shallowest queue (losing the warm prefix but bounding tail latency).
 
-The router is process-topology-agnostic: it sees only prompts and a
-depth vector. ``examples/serve_router.py`` drives real scheduler
-replicas in separate processes over pipes; unit tests drive it with
-synthetic depths.
+The router is process-topology-agnostic: it sees only prompts, a depth
+vector, and (optionally) a liveness mask from ``fleet.FleetHealth``.
+``examples/serve_router.py`` drives real scheduler replicas in separate
+processes over pipes; unit tests drive it with synthetic depths.
 """
 
 import zlib
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
+
+
+class NoLiveReplicasError(RuntimeError):
+    """Every replica in the fleet is down — nothing can take traffic."""
 
 
 class PrefixRouter:
@@ -32,6 +36,7 @@ class PrefixRouter:
         self.spill_slack = int(spill_slack)
         self.spills = 0
         self.affine = 0
+        self.failovers = 0
 
     def home(self, prompt: Sequence[int]) -> int:
         """The hash-affine replica for this prompt's leading block."""
@@ -39,25 +44,50 @@ class PrefixRouter:
         digest = zlib.crc32(repr(head).encode())
         return digest % self.n_replicas
 
-    def route(self, prompt: Sequence[int],
-              depths: Sequence[int]) -> Tuple[int, str]:
-        """(replica index, 'affine'|'spill') given reported queue depths."""
+    def route(self, prompt: Sequence[int], depths: Sequence[int],
+              live: Optional[Sequence[bool]] = None) -> Tuple[int, str]:
+        """(replica index, 'affine'|'spill'|'failover') given reported
+        queue depths and an optional liveness mask.
+
+        Only live replicas are candidates — for the home AND for spills
+        (routing to a dead replica loses the request outright). The home
+        mapping itself stays a pure hash: when a down replica recovers,
+        its mask bit flips back and every affine prompt returns to it
+        with no rebalancing step (re-affinity is free).
+        """
         if len(depths) != self.n_replicas:
             raise ValueError(
                 f"got {len(depths)} depths for {self.n_replicas} replicas")
+        if live is not None:
+            live = [bool(x) for x in live]
+            if len(live) != self.n_replicas:
+                raise ValueError(
+                    f"got {len(live)} live flags for "
+                    f"{self.n_replicas} replicas")
+            if not any(live):
+                raise NoLiveReplicasError(
+                    f"all {self.n_replicas} replicas are down")
         pref = self.home(prompt)
-        floor = min(depths)
+        candidates = [i for i in range(self.n_replicas)
+                      if live is None or live[i]]
+        if live is not None and not live[pref]:
+            # home is dead: deterministic hand-off to the shallowest
+            # survivor (ties to the lowest index)
+            self.failovers += 1
+            return min(candidates,
+                       key=lambda i: (depths[i], i)), "failover"
+        floor = min(depths[i] for i in candidates)
         if depths[pref] <= floor + self.spill_slack:
             self.affine += 1
             return pref, "affine"
         self.spills += 1
         # ties break to the lowest index — deterministic for tests
-        return min(range(self.n_replicas),
-                   key=lambda i: (depths[i], i)), "spill"
+        return min(candidates, key=lambda i: (depths[i], i)), "spill"
 
     def stats(self) -> dict:
-        total = self.affine + self.spills
+        total = self.affine + self.spills + self.failovers
         return {"affine": self.affine, "spills": self.spills,
+                "failovers": self.failovers,
                 "spill_rate": (self.spills / total) if total else 0.0}
 
 

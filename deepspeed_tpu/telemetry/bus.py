@@ -46,6 +46,12 @@ KIND_SERVE_PREFIX_HIT = "serve.prefix_hit"
 KIND_SERVE_PREFIX_MISS = "serve.prefix_miss"
 KIND_SERVE_PREFIX_EVICT = "serve.prefix_evict"
 KIND_SERVE_SHED = "serve.shed"
+KIND_SERVE_DEADLINE_SHED = "serve.deadline_shed"
+KIND_SERVE_REPLICA_DOWN = "serve.replica_down"
+KIND_SERVE_REPLICA_UP = "serve.replica_up"
+KIND_SERVE_FAILOVER = "serve.failover"
+KIND_SERVE_DRAIN = "serve.drain"
+KIND_SERVE_STATS = "serve.stats"
 KIND_SHUTDOWN = "shutdown.graceful"
 KIND_ELASTIC_RESHARD = "elastic.reshard"
 

@@ -1,24 +1,39 @@
 #!/usr/bin/env python
-"""Multi-replica serving front door: prefix-affine routing over N
-scheduler replicas in separate processes.
+"""Fault-tolerant multi-replica serving: prefix-affine routing, live
+token streaming, and exact failover when a replica is killed mid-decode.
 
 Each replica process owns one ``InferenceEngine`` + continuous-batching
 scheduler with its own prefix cache, bounded queue, and SLO admission
 controller (one replica == one accelerator's serving loop; here the
 replicas run on the CPU backend so the demo works anywhere). The parent
-is the front door: it routes a bursty prefix-skewed trace with
-``PrefixRouter`` — hash-affine on the prompt's leading block so one
-tenant's requests land where their prefix is warm, spilling to the
-shallowest queue when the home replica is overloaded — and aggregates
-per-replica serving stats, prefix hit rates, and shed counts.
+is the front door: ``FleetCoordinator`` routes requests hash-affine over
+the LIVE replicas, journals every delivered token, and when a replica's
+pipe hits EOF (its process died) migrates that replica's in-flight
+requests to survivors as exact replays — the survivor re-prefills
+``prompt + delivered tokens`` at the original pad offset, so greedy
+continuations are token-identical to the run that died.
 
-Wire protocol (pipe per replica, parent -> child):
-    ("submit", prompt, max_new)   -> ("ok", rid) | ("shed", reason)
-    ("depth",)                    -> ("depth", n)
-    ("run",)                      -> ("done", summary, frontdoor_stats)
-    ("quit",)                     -> child exits
+Wire protocol (one pipe per replica; messages, never blocking RPC):
+    parent -> child: ("submit", rid, prompt, max_new, replay|None)
+                     ("quit",)
+    child -> parent: ("hello", pid)            once, after engine build
+                     ("tok", rid, token, done) per DELIVERED token
+                     ("shed", rid, reason)     admission rejected it
+                     ("idle", pending)         run() drained its queue
+
+The child pumps its pipe BETWEEN decode steps (``run(poll_fn=...)``),
+so a failover replay lands in a survivor's free lane while it is still
+decoding its own work — no stop-the-world hand-off. The parent never
+issues a blocking request to a child (the depth probe of the old demo
+is replaced by journal-derived depths), so a dead child can never hang
+the front door: its death is an EOF, not a timeout.
+
+All replicas load IDENTICAL weights (same seed): exact failover replay
+is only meaningful when the survivor computes the same function as the
+deceased. Real fleets get this from a shared checkpoint.
 
 Run:  JAX_PLATFORMS=cpu python examples/serve_router.py [--replicas 2]
+          [--kill-replica auto | N | none] [--kill-after-tokens 6]
 """
 
 import argparse
@@ -26,22 +41,31 @@ import json
 import multiprocessing as mp
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# one serving config for every replica AND the bench's in-process
+# reference run — completions can only be compared across runs that
+# bucket, cache, and admit identically
+SERVING_CFG = {
+    "slots": 4,
+    "max_pending": 64,
+    "prefix_cache": {"promote_after": 2},
+    "admission": {"slo_ttft_p95_s": 30.0},  # generous: CPU demo
+}
 
-def replica_main(conn, seed: int):
-    """One scheduler replica: build a tiny ring-attention engine and
-    serve whatever the front door sends until ("quit",)."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+def build_engine(seed: int = 0):
+    """The demo's tiny ring-attention engine (shared with the chaos
+    bench so its reference run uses byte-identical weights)."""
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
     from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
         apply_sparse_attention
-    from deepspeed_tpu.serving import AdmissionRejected, build_serving
 
     cfg = GPTConfig(vocab_size=512, n_positions=512, n_embd=64, n_layer=2,
                     n_head=4, dtype=jnp.float32, rotary=True,
@@ -49,30 +73,193 @@ def replica_main(conn, seed: int):
     model = apply_sparse_attention(
         GPT(cfg), {"mode": "local_sliding_window", "block": 16,
                    "num_sliding_window_blocks": 3})
-    eng = deepspeed_tpu.init_inference(model, dtype="fp32", seed=seed)
-    sched = build_serving(eng, {
-        "slots": 4,
-        "max_pending": 64,
-        "prefix_cache": {"promote_after": 2},
-        "admission": {"slo_ttft_p95_s": 30.0},  # generous: CPU demo
-    })
-    while True:
-        msg = conn.recv()
+    return deepspeed_tpu.init_inference(model, dtype="fp32", seed=seed)
+
+
+def replica_main(conn, seed: int, serving_cfg=None):
+    """One scheduler replica: serve whatever the front door streams in
+    until ("quit",) or the pipe dies."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # own session => own process group, so the parent's
+    # reap_process_group(pid) can kill this replica's whole tree
+    # without signalling the parent
+    os.setsid()
+
+    from deepspeed_tpu.serving import AdmissionRejected, build_serving
+
+    eng = build_engine(seed)
+    sched = build_serving(eng, dict(serving_cfg or SERVING_CFG))
+    state = {"quit": False}
+
+    def handle(msg) -> None:
         if msg[0] == "submit":
-            _, prompt, max_new = msg
+            _, rid, prompt, max_new, replay = msg
+
+            def cb(_local_rid, token, done, _rid=rid):
+                conn.send(("tok", _rid, int(token), bool(done)))
+
             try:
-                rid = sched.submit(prompt, max_new_tokens=max_new)
-                conn.send(("ok", rid))
+                sched.submit(prompt, max_new_tokens=max_new,
+                             stream_callback=cb, replay_tokens=replay)
             except AdmissionRejected as e:
-                conn.send(("shed", e.reason))
-        elif msg[0] == "depth":
-            conn.send(("depth", len(sched._pending)))
-        elif msg[0] == "run":
-            stats = sched.run()
-            conn.send(("done", stats.summary(), sched.frontdoor_stats()))
+                conn.send(("shed", rid, e.reason))
         elif msg[0] == "quit":
-            conn.close()
-            return
+            state["quit"] = True
+
+    def pump():
+        # called between decode steps: failover replays submitted while
+        # this replica is mid-run land in its free lanes immediately
+        while conn.poll(0):
+            handle(conn.recv())
+
+    conn.send(("hello", os.getpid()))
+    try:
+        while not state["quit"]:
+            handle(conn.recv())
+            if state["quit"]:
+                break
+            if sched._pending:
+                sched.run(poll_fn=pump)
+                if not state["quit"]:
+                    conn.send(("idle", len(sched._pending)))
+    except (EOFError, OSError):
+        pass  # front door died; nothing left to serve
+    conn.close()
+
+
+def run_fleet(prompts, max_new: int = 8, replicas: int = 2, seed: int = 0,
+              kill_replica=None, kill_after_tokens: int = 6,
+              serving_cfg=None, verbose: bool = True):
+    """Serve ``prompts`` across ``replicas`` child processes; optionally
+    hard-kill one replica after it has delivered ``kill_after_tokens``
+    tokens, and failover its in-flight requests. Returns completions
+    (request id -> delivered tokens, replay prefix included) plus fleet
+    and router stats. ``kill_replica`` is an index, ``"auto"`` (the
+    replica holding the most requests), or None."""
+    from multiprocessing import connection as mpc
+
+    from deepspeed_tpu.serving import (FleetCoordinator, FleetHealth,
+                                       HealthConfig, PrefixRouter)
+    from deepspeed_tpu.utils.procgroup import reap_process_group
+
+    n = int(replicas)
+    router = PrefixRouter(n, align=16, spill_slack=2)
+    # the pipe EOF is the authoritative death signal here, so the
+    # silence timers are set far beyond the demo's runtime — an idle
+    # replica (blocked in recv between bursts) is not a dead one
+    health = FleetHealth(n, HealthConfig(suspect_after_s=60.0,
+                                         down_after_s=600.0))
+    coord = FleetCoordinator(router, health=health)
+
+    ctx = mp.get_context("spawn")  # fresh jax per replica
+    conns, procs, pids = [], [], {}
+    for i in range(n):
+        parent_c, child_c = ctx.Pipe()
+        p = ctx.Process(target=replica_main,
+                        args=(child_c, seed, serving_cfg), daemon=True)
+        p.start()
+        # the parent MUST drop its copy of the child end, or the pipe
+        # never EOFs when the child dies (the old demo's hang)
+        child_c.close()
+        conns.append(parent_c)
+        procs.append(p)
+    alive = [True] * n
+    for i, c in enumerate(conns):
+        msg = c.recv()  # ("hello", pid) — blocks until the engine built
+        pids[i] = msg[1]
+        coord.health.heartbeat(i)
+
+    placements = []
+    for rid, prompt in enumerate(prompts):
+        replica, how = coord.place(rid, list(prompt), max_new)
+        conns[replica].send(("submit", rid, list(prompt), max_new, None))
+        placements.append((replica, how))
+    if kill_replica == "auto":
+        by_load = [sum(1 for r, _ in placements if r == i)
+                   for i in range(n)]
+        kill_replica = max(range(n), key=lambda i: by_load[i])
+    killed = None
+    tokens_from = [0] * n
+
+    def on_dead(i: int):
+        alive[i] = False
+        conns[i].close()
+        moved = coord.replica_dead(i, reason="eof")
+        if verbose:
+            print(f"replica {i} died: migrating {len(moved)} in-flight "
+                  "request(s) to survivors")
+        for rid, target, spec in moved:
+            conns[target].send(("submit", rid, spec["prompt"],
+                                spec["max_new_tokens"],
+                                spec["replay_tokens"]))
+
+    while coord.journal.stats()["inflight"] > 0:
+        ready = mpc.wait([c for i, c in enumerate(conns) if alive[i]],
+                         timeout=1.0)
+        if not ready:
+            if not any(alive):
+                break  # every replica died with work outstanding
+            continue
+        for c in ready:
+            i = conns.index(c)
+            try:
+                msg = c.recv()
+            except (EOFError, OSError):
+                # recv drains buffered messages before raising, so
+                # every token that made it onto the wire was journaled
+                # — the replay cut is exactly the delivered prefix
+                on_dead(i)
+                continue
+            coord.health.heartbeat(i)
+            if msg[0] == "tok":
+                _, rid, token, done = msg
+                coord.on_token(rid, token, done=done)
+                tokens_from[i] += 1
+                if (killed is None and kill_replica == i
+                        and tokens_from[i] >= kill_after_tokens):
+                    killed = i
+                    if verbose:
+                        print(f"killing replica {i} mid-decode (after "
+                              f"{tokens_from[i]} delivered tokens)")
+                    reap_process_group(pids[i], term_timeout=2.0,
+                                       kill_timeout=5.0)
+            elif msg[0] == "shed":
+                coord.journal.record_shed(msg[1])
+                if verbose:
+                    print(f"request {msg[1]} shed by replica {i}: {msg[2]}")
+
+    for i, c in enumerate(conns):
+        if alive[i]:
+            try:
+                c.send(("quit",))
+            except (BrokenPipeError, OSError):
+                pass
+    for i, p in enumerate(procs):
+        p.join(timeout=30)
+        reap_process_group(pids[i], term_timeout=3.0, kill_timeout=5.0)
+
+    completions, per_request = {}, {}
+    for rid in range(len(prompts)):
+        e = coord.journal.entry(rid)
+        if e is None:
+            continue
+        completions[rid] = list(e.emitted)
+        per_request[rid] = {
+            "replica": e.replica, "failovers": e.failovers,
+            "done": e.done, "shed": e.shed,
+            "ttft_s": (None if e.t_first_token is None
+                       else e.t_first_token - e.t_submit),
+        }
+    return {
+        "completions": completions,
+        "per_request": per_request,
+        "placements": placements,
+        "killed_replica": killed,
+        "fleet": coord.stats(),
+        "router": router.stats(),
+        "health_transitions": [(i, frm, to) for _, i, frm, to
+                               in coord.health.transitions],
+    }
 
 
 def main():
@@ -80,70 +267,44 @@ def main():
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--kill-replica", default="auto",
+                    help="'auto', a replica index, or 'none'")
+    ap.add_argument("--kill-after-tokens", type=int, default=6)
     args = ap.parse_args()
 
     from benchmarks.inference.prefix_trace import make_bursty_prefix_trace
-    from deepspeed_tpu.serving import PrefixRouter
 
     # block must match the replicas' layout block (16 in the tiny model)
     prompts, meta = make_bursty_prefix_trace(
         args.requests, block=16, seed=0, num_prefixes=2,
         prefix_blocks=(4, 2), weights=(0.7, 0.3), suffix_base=9,
         burst_len=3, vocab=512)
-    router = PrefixRouter(args.replicas, align=16, spill_slack=2)
+    kill = args.kill_replica
+    if kill == "none":
+        kill = None
+    elif kill != "auto":
+        kill = int(kill)
 
-    ctx = mp.get_context("spawn")  # fresh jax per replica
-    conns, procs = [], []
-    for i in range(args.replicas):
-        parent, child = ctx.Pipe()
-        p = ctx.Process(target=replica_main, args=(child, i), daemon=True)
-        p.start()
-        conns.append(parent)
-        procs.append(p)
-
-    def depth(i):
-        conns[i].send(("depth",))
-        return conns[i].recv()[1]
-
-    placed, shed = [], 0
-    for prompt in prompts:
-        depths = [depth(i) for i in range(args.replicas)]
-        r, how = router.route(prompt, depths)
-        conns[r].send(("submit", prompt, args.max_new))
-        reply = conns[r].recv()
-        if reply[0] == "shed":
-            shed += 1
-            print(f"request shed by replica {r}: {reply[1]}")
-        else:
-            placed.append((r, how))
-
-    for c in conns:
-        c.send(("run",))
-    totals = {"tokens": 0, "sequences": 0}
-    for i, c in enumerate(conns):
-        _, summary, fd = c.recv()
-        totals["tokens"] += summary["total_generated_tokens"]
-        totals["sequences"] += summary["num_sequences"]
-        print(f"replica {i}: {summary['num_sequences']} seqs, "
-              f"{summary['total_generated_tokens']} tokens, "
-              f"ttft p95 {summary['ttft_s']['p95'] * 1e3:.0f}ms, "
-              f"prefix hit rate "
-              f"{fd['prefix']['hit_rate']:.2f}, shed {fd['shed']}")
-    for c in conns:
-        c.send(("quit",))
-    for p in procs:
-        p.join(timeout=30)
-
+    t0 = time.monotonic()
+    out = run_fleet(prompts, max_new=args.max_new, replicas=args.replicas,
+                    kill_replica=kill,
+                    kill_after_tokens=args.kill_after_tokens)
+    done = sum(1 for r in out["per_request"].values()
+               if r["done"] and not r["shed"])
+    migrated = sum(1 for r in out["per_request"].values()
+                   if r["failovers"] > 0)
     print(json.dumps({
         "replicas": args.replicas,
         "requests": args.requests,
         "trace_prefix_lens": meta["prefix_lens"],
-        "placements": [placed.count((i, "affine")) for i
-                       in range(args.replicas)],
-        "spills": router.stats()["spills"],
-        "shed": shed,
-        "served_sequences": totals["sequences"],
-        "served_tokens": totals["tokens"],
+        "killed_replica": out["killed_replica"],
+        "completed": done,
+        "migrated": migrated,
+        "lost": args.requests - done,
+        "served_tokens": sum(len(t) for t in out["completions"].values()),
+        "router": out["router"],
+        "health_transitions": out["health_transitions"],
+        "wall_s": round(time.monotonic() - t0, 2),
     }, indent=2))
 
 

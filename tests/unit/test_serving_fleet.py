@@ -1,0 +1,584 @@
+"""Serving-fleet fault tolerance tests: replica health state machine,
+request journaling, deadline shedding, graceful drain, and exact
+in-flight failover replay.
+
+The fast half drives the policy layer (health/journal/coordinator/
+admission aging) with injected clocks and the scheduler's submit path
+with an uncompiled engine. The ``slow`` half proves the replay contract
+on a real ring model — a completion resumed from a journaled prefix
+must be token-identical to the uninterrupted run — and runs the whole
+multi-process kill-and-failover loop once.
+"""
+
+import signal
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.scheduler import (ContinuousBatchingScheduler,
+                                               DeadlineExceededError,
+                                               DrainingError)
+from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
+    apply_sparse_attention
+from deepspeed_tpu.serving import (DOWN, HEALTHY, RECOVERING, SUSPECT,
+                                   AdmissionConfig, FleetCoordinator,
+                                   FleetHealth, GracefulDrain, HealthConfig,
+                                   NoLiveReplicasError, PrefixRouter,
+                                   RequestJournal, SLOAdmissionController,
+                                   build_serving)
+from deepspeed_tpu.telemetry.bus import (KIND_SERVE_DEADLINE_SHED,
+                                         KIND_SERVE_DRAIN,
+                                         KIND_SERVE_FAILOVER,
+                                         KIND_SERVE_FIRST_TOKEN,
+                                         KIND_SERVE_REPLICA_DOWN,
+                                         KIND_SERVE_REPLICA_UP,
+                                         KIND_SERVE_STATS, TelemetryBus,
+                                         telemetry_bus)
+
+_WINDOW = {"mode": "local_sliding_window", "block": 16,
+           "num_sliding_window_blocks": 3}
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+                n_head=4, dtype=jnp.float32, scan_layers=True)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _ring_model(**kw):
+    return apply_sparse_attention(GPT(_cfg(**kw)), _WINDOW)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class _BusTap:
+    def __init__(self, *kinds):
+        self.kinds = set(kinds)
+        self.events = []
+
+    def __enter__(self):
+        def tap(ev):
+            if ev["kind"] in self.kinds:
+                self.events.append(ev)
+
+        self._tap = tap
+        telemetry_bus.subscribe(tap)
+        return self
+
+    def __exit__(self, *exc):
+        telemetry_bus.unsubscribe(self._tap)
+
+
+# ---------------------------------------------------------------------
+class TestFleetHealth:
+    def _h(self, n=3, **kw):
+        clock = _Clock()
+        bus = TelemetryBus()
+        evs = []
+        bus.subscribe(evs.append)
+        cfg = HealthConfig(**{**dict(suspect_after_s=1.0, down_after_s=3.0,
+                                     recover_probes=2), **kw})
+        return FleetHealth(n, cfg, clock=clock, bus=bus), clock, evs
+
+    def test_silence_schedule_degrades(self):
+        h, clock, _ = self._h()
+        clock.t = 1.5
+        h.heartbeat(0)
+        h.sweep()
+        assert h.state(0) == HEALTHY and h.state(1) == SUSPECT
+        clock.t = 3.5
+        h.sweep()
+        assert h.state(1) == DOWN
+        assert h.live() == [True, False, False]
+
+    def test_suspect_stays_routable(self):
+        h, clock, _ = self._h()
+        clock.t = 1.5
+        h.sweep()
+        assert all(s == SUSPECT for s in h.states().values())
+        assert h.live() == [True, True, True]
+
+    def test_eof_beats_timers(self):
+        h, _, evs = self._h()
+        h.mark_down(2, reason="eof")
+        assert h.state(2) == DOWN
+        assert [e["kind"] for e in evs] == [KIND_SERVE_REPLICA_DOWN]
+        assert evs[0]["replica"] == 2 and evs[0]["reason"] == "eof"
+
+    def test_recovery_needs_probes_and_publishes_once(self):
+        h, clock, evs = self._h()
+        h.mark_down(0)
+        h.heartbeat(0)
+        assert h.state(0) == RECOVERING
+        assert h.live()[0]  # recovering gets its homes back already
+        h.heartbeat(0)
+        assert h.state(0) == HEALTHY
+        kinds = [e["kind"] for e in evs]
+        assert kinds == [KIND_SERVE_REPLICA_DOWN, KIND_SERVE_REPLICA_UP]
+
+    def test_heartbeat_clears_suspect_silently(self):
+        h, clock, evs = self._h()
+        clock.t = 1.5
+        h.sweep()
+        h.heartbeat(1)
+        assert h.state(1) == HEALTHY
+        # suspect<->healthy flapping must not spam the bus
+        assert evs == []
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            HealthConfig(suspect_after_s=5.0, down_after_s=2.0)
+        with pytest.raises(ValueError):
+            HealthConfig(recover_probes=0)
+
+
+# ---------------------------------------------------------------------
+class TestRequestJournal:
+    def test_flight_record_and_replay_spec(self):
+        j = RequestJournal(clock=_Clock())
+        j.record_submit(7, [1, 2, 3], 8, replica=1)
+        j.record_token(7, 11)
+        j.record_token(7, 12)
+        spec = j.replay_spec(7)
+        assert spec == {"prompt": [1, 2, 3], "replay_tokens": [11, 12],
+                        "max_new_tokens": 8, "deadline": None}
+        assert j.entry(7).remaining_tokens == 6
+
+    def test_duplicate_submit_raises(self):
+        j = RequestJournal()
+        j.record_submit(1, [1], 4)
+        with pytest.raises(ValueError, match="already journaled"):
+            j.record_submit(1, [2], 4)
+
+    def test_done_requests_are_not_replayable(self):
+        j = RequestJournal()
+        j.record_submit(1, [1], 2)
+        j.record_token(1, 5)
+        j.record_token(1, 6, done=True)
+        assert j.entry(1).done
+        with pytest.raises(ValueError, match="already finished"):
+            j.replay_spec(1)
+        # late tokens racing the completion are dropped, not crashed
+        j.record_token(1, 7)
+        assert j.entry(1).emitted == [5, 6]
+
+    def test_unknown_ids_tolerated(self):
+        j = RequestJournal()
+        j.record_token(99, 1)
+        j.record_done(99)
+        j.record_shed(99)
+        assert len(j) == 0
+
+    def test_depths_and_inflight_filter(self):
+        j = RequestJournal()
+        j.record_submit(0, [1], 4, replica=0)
+        j.record_submit(1, [2], 4, replica=1)
+        j.record_submit(2, [3], 4, replica=1)
+        j.record_token(1, 9, done=False)
+        assert j.depths(3) == [1, 2, 0]
+        assert [e.request_id for e in j.inflight(replica=1)] == [1, 2]
+        j.record_done(1)
+        assert j.depths(3) == [1, 1, 0]
+
+    def test_shed_counts_but_never_completes(self):
+        j = RequestJournal()
+        j.record_submit(0, [1], 4)
+        j.record_shed(0)
+        st = j.stats()
+        assert st["shed"] == 1 and st["completed"] == 0
+        assert st["inflight"] == 0
+
+
+# ---------------------------------------------------------------------
+class TestFleetCoordinator:
+    def _coord(self, n=3):
+        clock = _Clock()
+        bus = TelemetryBus()
+        evs = []
+        bus.subscribe(evs.append)
+        router = PrefixRouter(n, align=4, spill_slack=10)
+        health = FleetHealth(n, clock=clock, bus=bus)
+        coord = FleetCoordinator(router, health=health,
+                                 journal=RequestJournal(clock=clock),
+                                 clock=clock, bus=bus)
+        return coord, evs
+
+    def test_failover_is_exact_and_announced_once(self):
+        coord, evs = self._coord()
+        homes = {}
+        for rid in range(6):
+            prompt = [rid * 3 + k for k in range(6)]
+            rep, _ = coord.place(rid, prompt, 8)
+            homes[rid] = rep
+            coord.on_token(rid, 100 + rid)
+        victim = homes[0]
+        moved = coord.replica_dead(victim, reason="eof")
+        victim_rids = sorted(r for r, h in homes.items() if h == victim)
+        assert sorted(r for r, _, _ in moved) == victim_rids
+        for rid, target, spec in moved:
+            assert target != victim
+            assert spec["replay_tokens"] == [100 + rid]
+            assert spec["max_new_tokens"] == 8
+        fo = [e for e in evs if e["kind"] == KIND_SERVE_FAILOVER]
+        assert sorted(e["request_id"] for e in fo) == victim_rids
+        assert all(e["from_replica"] == victim and e["emitted"] == 1
+                   and e["remaining"] == 7 for e in fo)
+
+    def test_done_requests_do_not_migrate(self):
+        coord, evs = self._coord()
+        rep, _ = coord.place(0, [1, 2, 3], 4)
+        coord.on_token(0, 9, done=True)
+        assert coord.replica_dead(rep) == []
+        assert not [e for e in evs if e["kind"] == KIND_SERVE_FAILOVER]
+
+    def test_routing_skips_dead_and_reaffines_after_recovery(self):
+        coord, _ = self._coord(n=2)
+        prompt = [5, 6, 7, 8]
+        home = coord.router.home(prompt)
+        coord.health.mark_down(home)
+        rep, how = coord.place(0, prompt, 4)
+        assert rep != home and how == "failover"
+        # a recovered home gets its affine traffic back with no
+        # rebalancing step: only the mask changed, never the hash
+        coord.health.heartbeat(home)
+        coord.health.heartbeat(home)
+        rep2, how2 = coord.place(1, prompt, 4)
+        assert rep2 == home and how2 == "affine"
+
+    def test_all_dead_raises(self):
+        coord, _ = self._coord(n=2)
+        coord.health.mark_down(0)
+        coord.health.mark_down(1)
+        with pytest.raises(NoLiveReplicasError):
+            coord.place(0, [1, 2], 4)
+
+    def test_router_rejects_bad_live_mask(self):
+        r = PrefixRouter(2)
+        with pytest.raises(ValueError, match="live flags"):
+            r.route([1, 2], [0, 0], live=[True])
+
+
+# ---------------------------------------------------------------------
+class TestAdmissionSampleAging:
+    """Satellites 1+4: the TTFT window must age out stale samples, and
+    the recovery edge with an EMPTY window must still wait for the
+    queue to drain."""
+
+    def _ctl(self, **kw):
+        clock = _Clock()
+        cfg = AdmissionConfig(**{**dict(slo_ttft_p95_s=1.0, window=16,
+                                        min_samples=4,
+                                        sample_max_age_s=30.0), **kw})
+        ctl = SLOAdmissionController(cfg, bus=TelemetryBus(), clock=clock)
+        return ctl, clock
+
+    def _feed(self, ctl, ttft, n):
+        for _ in range(n):
+            ctl.on_event({"kind": KIND_SERVE_FIRST_TOKEN, "ttft_s": ttft})
+
+    def test_stale_samples_age_out(self):
+        ctl, clock = self._ctl()
+        self._feed(ctl, 5.0, 6)
+        assert ctl.p95_ttft() == 5.0
+        clock.t = 31.0
+        # an idle gap longer than sample_max_age_s empties the window:
+        # breach-era evidence no longer describes the replica
+        assert ctl.p95_ttft() is None
+        assert len(ctl._ttfts) == 0
+
+    def test_aging_disabled_with_none(self):
+        ctl, clock = self._ctl(sample_max_age_s=None)
+        self._feed(ctl, 5.0, 6)
+        clock.t = 1e6
+        assert ctl.p95_ttft() == 5.0
+
+    def test_partial_age_out_keeps_fresh_samples(self):
+        ctl, clock = self._ctl()
+        self._feed(ctl, 9.0, 4)
+        clock.t = 20.0
+        self._feed(ctl, 0.1, 4)
+        clock.t = 40.0  # first batch >30s old, second 20s old
+        assert ctl.p95_ttft() == 0.1
+        assert len(ctl._ttfts) == 4
+
+    def test_recovery_with_empty_window_waits_for_drain(self):
+        ctl, clock = self._ctl()
+        self._feed(ctl, 5.0, 6)
+        admit, _ = ctl.decide(queue_depth=8, slots=2)
+        assert not admit and ctl._shedding
+        clock.t = 31.0  # whole window ages out -> p95 is None
+        assert ctl.p95_ttft() is None
+        admit, reason = ctl.decide(queue_depth=8, slots=2)
+        assert not admit and ctl._shedding, \
+            "p95=None must not reopen admission over a loaded queue"
+        assert "queue" in reason
+        admit, _ = ctl.decide(queue_depth=2, slots=2)
+        assert admit and not ctl._shedding
+
+    def test_existing_recovery_path_still_hysteretic(self):
+        ctl, clock = self._ctl()
+        self._feed(ctl, 5.0, 6)
+        assert not ctl.decide(queue_depth=8, slots=2)[0]
+        clock.t = 31.0
+        self._feed(ctl, 0.1, 6)  # fresh, fast completions
+        assert not ctl.decide(queue_depth=8, slots=2)[0]  # queue loaded
+        assert ctl.decide(queue_depth=1, slots=2)[0]
+
+
+# ---------------------------------------------------------------------
+class TestSchedulerDeadlinesAndDrain:
+    """Submit-path behavior needs no compiled engine: the scheduler
+    only touches the model config until run()."""
+
+    def _sched(self, **kw):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        kw.setdefault("prompt_bucket", 8)
+        return ContinuousBatchingScheduler(eng, slots=2, **kw)
+
+    def test_expired_deadline_at_submit_is_typed_and_published(self):
+        rejected = []
+        sched = self._sched(
+            reject_callback=lambda rid, reason: rejected.append(reason))
+        with _BusTap(KIND_SERVE_DEADLINE_SHED) as tap:
+            with pytest.raises(DeadlineExceededError) as ei:
+                sched.submit([1, 2, 3], deadline_s=0.0)
+        assert ei.value.reason == "deadline"
+        assert rejected == ["deadline"]
+        assert sched.deadline_shed_count == 1
+        assert tap.events and tap.events[0]["reason"] == "deadline"
+
+    def test_replay_must_leave_token_budget(self):
+        sched = self._sched()
+        with pytest.raises(ValueError, match="exhausts"):
+            sched.submit([1, 2], max_new_tokens=3,
+                         replay_tokens=[5, 6, 7])
+
+    def test_drain_closes_admission(self):
+        sched = self._sched()
+        sched.submit([1, 2, 3])
+        with _BusTap(KIND_SERVE_DRAIN) as tap:
+            sched.begin_drain(reason="test")
+            sched.begin_drain(reason="twice")  # idempotent
+        assert sched.draining and sched.drain_reason == "test"
+        assert len(tap.events) == 1
+        assert tap.events[0]["phase"] == "begin"
+        with pytest.raises(DrainingError):
+            sched.submit([4, 5])
+
+    def test_journal_hook_records_submissions(self):
+        j = RequestJournal()
+        sched = self._sched(journal=j)
+        rid = sched.submit([1, 2, 3], max_new_tokens=5, deadline_s=60.0)
+        e = j.entry(rid)
+        assert e.prompt == [1, 2, 3] and e.max_new_tokens == 5
+        assert e.deadline is not None
+
+    def test_frontdoor_stats_surface_new_counters(self):
+        h = FleetHealth(2, bus=TelemetryBus())
+        sched = self._sched(journal=RequestJournal(), health_provider=h)
+        st = sched.frontdoor_stats()
+        assert st["deadline_shed"] == 0 and st["draining"] is False
+        assert st["journal"]["inflight"] == 0
+        assert st["health"] == {0: HEALTHY, 1: HEALTHY}
+
+
+# ---------------------------------------------------------------------
+class TestGracefulDrain:
+    class _Recorder:
+        def __init__(self):
+            self.retracted = 0
+
+        def retract_dump(self):
+            self.retracted += 1
+
+    def _sched(self, journal):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        return ContinuousBatchingScheduler(eng, slots=2, prompt_bucket=8,
+                                           journal=journal)
+
+    def test_sigterm_triggers_drain_and_complete_hands_off(self):
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            pytest.skip("signal handlers install from the main thread only")
+        j = RequestJournal()
+        sched = self._sched(j)
+        sched.submit([1, 2, 3], max_new_tokens=4)
+        sched.submit([4, 5], max_new_tokens=4)
+        rec = self._Recorder()
+        bus = TelemetryBus()
+        evs = []
+        bus.subscribe(evs.append)
+        prev = signal.getsignal(signal.SIGTERM)
+        drain = GracefulDrain(sched, recorder=rec, bus=bus)
+        uninstall = drain.install(signals=("SIGTERM",))
+        try:
+            signal.raise_signal(signal.SIGTERM)
+            assert sched.draining
+            assert sched.drain_reason == "signal:SIGTERM"
+            handoff = drain.complete()
+        finally:
+            uninstall()
+        assert signal.getsignal(signal.SIGTERM) is prev
+        assert [h["prompt"] for h in handoff] == [[1, 2, 3], [4, 5]]
+        assert all(h["replay_tokens"] == [] for h in handoff)
+        # a drained exit is a clean exit: the signal-time blackbox from
+        # the crash handlers is stale evidence and must be retracted
+        assert rec.retracted == 1
+        done = [e for e in evs if e["kind"] == KIND_SERVE_DRAIN]
+        assert len(done) == 1 and done[0]["phase"] == "complete"
+        assert done[0]["handed_off"] == 2 and done[0]["clean"]
+
+    def test_complete_without_journal_hands_off_nothing(self):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(eng, slots=2, prompt_bucket=8)
+        drain = GracefulDrain(sched, bus=TelemetryBus())
+        sched.begin_drain()
+        assert drain.complete() == []
+        assert drain.drained
+
+
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+class TestFailoverReplayExactness:
+    """The acceptance contract: a completion resumed from a journaled
+    prefix must be token-identical to the uninterrupted run — the
+    replayed prefill takes the same pad offset and chunk geometry, so
+    greedy decode continues bit-exactly."""
+
+    def _eng(self):
+        model = _ring_model(rotary=True, learned_positions=False)
+        return InferenceEngine(model, {"dtype": "fp32"}, seed=0)
+
+    def _serve_one(self, eng, prompt, max_new, replay=None):
+        sched = ContinuousBatchingScheduler(eng, slots=2, prompt_bucket=16)
+        sched.submit(prompt, max_new_tokens=max_new, replay_tokens=replay)
+        stats = sched.run()
+        assert len(stats.completions) == 1
+        return list(stats.completions[0].tokens)
+
+    def test_resume_matches_uninterrupted_at_every_cut(self):
+        eng = self._eng()
+        rng = np.random.default_rng(3)
+        prompt = list(rng.integers(1, 128, size=21))
+        max_new = 8
+        ref = self._serve_one(eng, prompt, max_new)
+        assert len(ref) == max_new
+        for cut in (1, 3, max_new - 1):
+            resumed = self._serve_one(eng, prompt, max_new,
+                                      replay=ref[:cut])
+            assert resumed == ref, f"cut={cut} diverged"
+
+    def test_resume_across_ring_boundary(self):
+        # prompt + replay crosses the 32-slot ring: the continuation
+        # spans must chunk block-by-block exactly like the cold path
+        eng = self._eng()
+        rng = np.random.default_rng(4)
+        prompt = list(rng.integers(1, 128, size=30))
+        max_new = 12
+        ref = self._serve_one(eng, prompt, max_new)
+        resumed = self._serve_one(eng, prompt, max_new, replay=ref[:5])
+        assert resumed == ref
+
+    def test_replay_streams_only_new_tokens(self):
+        eng = self._eng()
+        rng = np.random.default_rng(5)
+        prompt = list(rng.integers(1, 128, size=10))
+        ref = self._serve_one(eng, prompt, 6)
+        sched = ContinuousBatchingScheduler(eng, slots=1, prompt_bucket=16)
+        streamed = []
+        with _BusTap(KIND_SERVE_FIRST_TOKEN) as tap:
+            sched.submit(prompt, max_new_tokens=6, replay_tokens=ref[:2],
+                         stream_callback=lambda rid, t, d:
+                         streamed.append(t))
+            sched.run()
+        # the client already holds the replayed prefix; only the
+        # regenerated tail goes back onto the wire, and the replay does
+        # not re-publish serve.first_token (it would bias the p95 window)
+        assert streamed == ref[2:]
+        assert tap.events == []
+
+
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+class TestDeadlineQueueExpiry:
+    def test_expired_queue_entries_shed_before_occupying_a_lane(self):
+        eng = InferenceEngine(
+            _ring_model(rotary=True, learned_positions=False),
+            {"dtype": "fp32"}, seed=0)
+        rejected = []
+        sched = ContinuousBatchingScheduler(
+            eng, slots=1, prompt_bucket=16,
+            journal=RequestJournal(),
+            reject_callback=lambda rid, r: rejected.append((rid, r)))
+        live = sched.submit([1, 2, 3], max_new_tokens=3)
+        doomed = sched.submit([4, 5, 6], max_new_tokens=3,
+                              deadline_s=1e-6)
+        time.sleep(0.01)
+        with _BusTap(KIND_SERVE_DEADLINE_SHED, KIND_SERVE_STATS) as tap:
+            stats = sched.run()
+        assert [c.request_id for c in stats.completions] == [live]
+        assert rejected == [(doomed, "deadline")]
+        assert sched.deadline_shed_count == 1
+        shed = [e for e in tap.events
+                if e["kind"] == KIND_SERVE_DEADLINE_SHED]
+        assert len(shed) == 1 and shed[0]["request_id"] == doomed
+        assert shed[0]["late_s"] > 0
+        # the journal closed the entry: nothing to failover later
+        assert sched.journal.stats()["inflight"] == 0
+        assert sched.journal.entry(doomed).shed
+        # satellite 3: per-iteration serve.stats snapshots
+        snaps = [e for e in tap.events if e["kind"] == KIND_SERVE_STATS]
+        assert snaps and all("queue_depth" in e and "lanes_active" in e
+                             and "deadline_shed" in e for e in snaps)
+        assert snaps[-1]["deadline_shed"] == 1
+
+
+# ---------------------------------------------------------------------
+@pytest.mark.slow
+class TestMultiProcessFailover:
+    def test_kill_one_replica_zero_lost_token_identical(self):
+        """End-to-end: kill one of two replica processes mid-decode;
+        every request must complete token-identically to an
+        uninterrupted single-process run."""
+        from examples.serve_router import (SERVING_CFG, build_engine,
+                                           run_fleet)
+
+        rng = np.random.default_rng(11)
+        prompts = [list(rng.integers(1, 512, size=int(n)))
+                   for n in rng.integers(8, 40, size=6)]
+        max_new = 8
+
+        sched = build_serving(build_engine(seed=0), dict(SERVING_CFG))
+        order = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        by_rid = {c.request_id: list(c.tokens)
+                  for c in sched.run().completions}
+        reference = {i: by_rid[rid] for i, rid in enumerate(order)}
+
+        with _BusTap(KIND_SERVE_FAILOVER, KIND_SERVE_REPLICA_DOWN) as tap:
+            out = run_fleet(prompts, max_new=max_new, replicas=2,
+                            kill_replica="auto", kill_after_tokens=4,
+                            verbose=False)
+        assert out["killed_replica"] is not None
+        migrated = sorted(rid for rid, r in out["per_request"].items()
+                          if r["failovers"] > 0)
+        assert migrated, "the kill must catch in-flight requests"
+        for rid, ref in reference.items():
+            assert out["completions"][rid] == ref, f"request {rid} diverged"
+        fo = sorted(e["request_id"] for e in tap.events
+                    if e["kind"] == KIND_SERVE_FAILOVER)
+        assert fo == migrated  # exactly one failover event per migration
+        downs = [e for e in tap.events
+                 if e["kind"] == KIND_SERVE_REPLICA_DOWN]
+        assert len(downs) == 1
+        assert downs[0]["replica"] == out["killed_replica"]
