@@ -36,6 +36,10 @@
 #                inference/serving_bench_prefix_results.json)
 #   make serve-bench-uniform  the original uniform-trace CB-vs-sequential
 #                comparison (serving_bench_results.json)
+#   make serve-bench-disagg  disaggregated topology on the bursty trace:
+#                prefill/decode split vs front door, int8-KV + spec-
+#                decode tier, lanes-per-replica capacity table (commits
+#                benchmarks/inference/serving_bench_disagg_results.json)
 #   make data-bench  packed input pipeline: dataloader+h2d phase share
 #                with background prefetch off vs on (commits
 #                benchmarks/data/input_pipeline_bench_results.json)
@@ -69,7 +73,8 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/runtime/step_autotune.py
 
 .PHONY: quick test smoke chaos chaos-serve profile blackbox memreport \
-        check hooks hot-changed serve-bench serve-bench-uniform data-bench \
+        check hooks hot-changed serve-bench serve-bench-uniform \
+        serve-bench-disagg data-bench \
         dryrun mfu-search mfu-search-full overlap-measured
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
@@ -85,6 +90,7 @@ quick:
 	  tests/unit/test_launcher.py tests/unit/test_serving.py \
 	  tests/unit/test_serving_frontdoor.py \
 	  tests/unit/test_serving_fleet.py \
+	  tests/unit/test_serving_disagg.py \
 	  tests/unit/test_data_pipeline.py tests/unit/test_telemetry.py \
 	  tests/unit/test_step_autotune.py \
 	  tests/unit/test_elastic_reshard.py \
@@ -151,6 +157,16 @@ serve-bench:
 # reuse); writes benchmarks/inference/serving_bench_results.json.
 serve-bench-uniform:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/inference/serving_bench.py
+
+# disaggregated serving on the same bursty trace: prefill/decode split
+# (DisaggServer + KV hand-off) vs the front door, plus int8-KV + spec-
+# decode decode tier and the lanes-per-replica capacity table
+# (docs/performance.md "Disaggregated serving"). Writes benchmarks/
+# inference/serving_bench_disagg_results.json; exits nonzero unless
+# disagg tokens are identical to the front door's, int8 capacity beats
+# bf16 >= 1.7x / fp32 >= 3.0x, and spec acceptance >= 0.5.
+serve-bench-disagg:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/inference/serving_disagg_bench.py
 
 # packed input pipeline: dataloader+h2d share of step time with
 # data_pipeline.prefetch off vs on (docs/data.md). Writes

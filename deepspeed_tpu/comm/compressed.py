@@ -28,14 +28,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.comm.logging import comms_logger
-from deepspeed_tpu.ops.quantizer import dequantize, quantize
+from deepspeed_tpu.ops.quantizer import dequantize, quantize_blockwise
 
 
 def _quantize_blocks(flat: jnp.ndarray, block: int
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    q, scale, _ = quantize(flat, num_bits=8,
-                           num_groups=flat.size // block, symmetric=True)
-    return q, scale
+    # shared blockwise int8 (ops/quantizer.py) — the same format the int8
+    # KV cache stores, so wire and cache cannot drift
+    return quantize_blockwise(flat, block)
 
 
 def server_shard_length(n: int, w: int, block: int = 512) -> int:

@@ -74,14 +74,16 @@ def prefill_chunk_spans(model_cfg, T: int):
     per pass. The partial tail span stays inside one block, so it is exact
     too. ``<= ring_len``-token passes per the model's prefill guard.
     """
-    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
-        ring_engaged
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        ring_engaged,
+        ring_storage_len,
+    )
 
     ring = ring_engaged(model_cfg) if model_cfg is not None else None
     if ring is None:
         return None
     w_blk, g_tok, blk = ring
-    ring_len = (w_blk + 1) * blk
+    ring_len = ring_storage_len(model_cfg, ring)
     if T <= ring_len:
         return None
     return [(s, min(s + blk, T)) for s in range(0, T, blk)]
@@ -101,15 +103,17 @@ def continuation_chunk_spans(model_cfg, start: int, end: int):
     nothing is evicted at all, so one pass is exact regardless of
     alignment; dense caches are always one pass.
     """
-    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import \
-        ring_engaged
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        ring_engaged,
+        ring_storage_len,
+    )
 
     if not 0 <= start < end:
         raise ValueError(f"bad continuation span [{start}, {end})")
     ring = ring_engaged(model_cfg) if model_cfg is not None else None
     if ring is not None:
         w_blk, g_tok, blk = ring
-        ring_len = (w_blk + 1) * blk
+        ring_len = ring_storage_len(model_cfg, ring)
         if end > ring_len:
             return [(s, min(end, (s // blk + 1) * blk))
                     for s in range(start, end)
@@ -219,6 +223,25 @@ class InferenceEngine:
                     "measures slower than bf16 (0.84-0.96x at 125M, "
                     "benchmarks/inference/int8_results.json); the win "
                     "starts around 350M params")
+
+        # int8 KV cache (serving capacity lever, GPTConfig.kv_cache_dtype):
+        # orthogonal to weight quantization — "kv_cache": "int8" stores the
+        # decode cache int8 with per-slot f32 scales and dequantizes on
+        # read (models/transformer_lm.py decode attention). Same clone
+        # pattern as quantized_weights above.
+        kv_cache = config.get("kv_cache")
+        if kv_cache is not None:
+            import dataclasses as _dc
+
+            kcfg = getattr(model, "config", None)
+            if kcfg is None or not any(f.name == "kv_cache_dtype"
+                                       for f in _dc.fields(kcfg)):
+                raise ValueError(
+                    "inference config 'kv_cache' needs a model whose config "
+                    "carries kv_cache_dtype (models/transformer_lm.GPTConfig)")
+            model = model.clone(config=_dc.replace(
+                kcfg, kv_cache_dtype=kv_cache))
+            self.module = model
 
         # injection policy -> TP sharding rules (reference
         # _apply_injection_policy, inference/engine.py:364)
@@ -487,10 +510,25 @@ class InferenceEngine:
             # toks: [k, B] -> [B, k]
             return toks.swapaxes(0, 1), tok, cache, rng
 
+        def verify_greedy(params, toks, cache):
+            """Speculative-decode verification: ONE batched forward over
+            ``[B, k+1]`` columns ``[t0, d1..dk]``. Column ``j``'s logits
+            condition on ``t0..d_j`` exactly as sequential decode would, so
+            ``argmax`` per column IS the greedy token after accepting ``j``
+            drafts — acceptance is a host-side prefix match, and the
+            scheduler rewinds the cache clocks past the first mismatch
+            (ContinuousBatchingScheduler._rewind)."""
+            logits, vars_out = model.apply(
+                {"params": self._dequant(params), "cache": cache}, toks,
+                deterministic=True, decode=True, mutable=["cache"])
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                vars_out["cache"]
+
         self._prefill_fn = jax.jit(prefill)
         self._prefill_more_fn = jax.jit(prefill_more, donate_argnums=(3,))
         self._decode_k_fn = jax.jit(decode_k, static_argnums=(5,),
                                     donate_argnums=(2,))
+        self._verify_greedy_fn = jax.jit(verify_greedy, donate_argnums=(2,))
 
     def _chunked_prefill(self, input_ids, attention_mask):
         """Prefill ``input_ids`` exactly: one pass when that is exact,

@@ -110,6 +110,12 @@ class Request:
     # max_new_tokens budget. Greedy decode is a pure function of
     # (weights, tokens-so-far), so the continuation is token-identical.
     replay_tokens: Optional[List[int]] = None
+    # disaggregated serving hand-off (serving/disagg.py): a prefill
+    # replica already ran this prompt's exact chunked prefill, and
+    # admission splices the handed ``(first_token, [1, ...] cache)``
+    # into a lane instead of prefilling locally. The producer must have
+    # used the SAME prompt_bucket — the cache bakes in the pad offset.
+    kv_handoff: Optional[Any] = None
 
 
 @dataclass
@@ -194,7 +200,9 @@ class ContinuousBatchingScheduler:
                  admission_controller=None,
                  reject_callback: Optional[Callable] = None,
                  journal=None,
-                 health_provider=None):
+                 health_provider=None,
+                 draft_engine=None,
+                 spec_k: int = 0):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_pending is not None and max_pending < 1:
@@ -216,12 +224,23 @@ class ContinuousBatchingScheduler:
         #       record_shed), the exact-failover flight record
         #   health_provider — .states() dict folded into frontdoor_stats
         #       and the per-iteration serve.stats event
+        #   draft_engine + spec_k — draft-model speculative decoding: the
+        #       draft proposes spec_k greedy tokens per lane per step, ONE
+        #       batched target forward verifies them, and the per-row
+        #       cache clocks rewind past the first mismatch. Exact vs
+        #       sequential greedy by construction (every emitted token is
+        #       a target-argmax given its prefix), so it composes with
+        #       failover replay and the prefix cache unchanged.
         self.max_pending = None if max_pending is None else int(max_pending)
         self.prefix_cache = prefix_cache
         self.admission_controller = admission_controller
         self.reject_callback = reject_callback
         self.journal = journal
         self.health_provider = health_provider
+        self.draft_engine = draft_engine
+        self.spec_k = int(spec_k)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.shed_count = 0
         self.deadline_shed_count = 0
         self._draining = False
@@ -250,18 +269,68 @@ class ContinuousBatchingScheduler:
         self._streaming = (self._ring is not None and
                            not getattr(self._mcfg, "learned_positions", True))
 
+        # speculative decoding preconditions — checked HERE, not in the
+        # hot loop, because every one of them is a config property
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if (draft_engine is None) != (self.spec_k == 0):
+            raise ValueError(
+                "speculative decoding needs BOTH a draft_engine and "
+                f"spec_k >= 1 (got draft_engine="
+                f"{'set' if draft_engine is not None else 'None'}, "
+                f"spec_k={spec_k})")
+        self._draft_mcfg = None
+        self._draft_ring = None
+        if draft_engine is not None:
+            if self.temperature != 0.0:
+                raise ValueError(
+                    "speculative decoding here is EXACT-greedy only "
+                    "(accepted tokens are target argmaxes); temperature "
+                    f"must be 0.0, got {temperature}")
+            if self._ring is not None:
+                blk = self._ring[2]
+                slack = int(getattr(self._mcfg, "kv_cache_slack_blocks",
+                                    0) or 0)
+                if slack < 1:
+                    raise ValueError(
+                        "speculative decoding over a ring KV cache needs "
+                        "kv_cache_slack_blocks >= 1 on the TARGET model: "
+                        "the k+1-column verify pass writes every column "
+                        "before attention reads, and without a slack "
+                        "block an unaligned pass can evict entries its "
+                        "own earlier columns still need "
+                        "(ops/sparse_attention ring_storage_len)")
+                if self.spec_k > blk:
+                    raise ValueError(
+                        f"spec_k ({spec_k}) must be <= the ring layout "
+                        f"block ({blk}): one slack block makes passes of "
+                        "at most `block` tokens exact")
+            self._draft_mcfg = getattr(draft_engine.module, "config", None)
+            self._draft_ring = (ring_engaged(self._draft_mcfg)
+                                if self._draft_mcfg is not None else None)
+            if self._draft_ring is not None and \
+                    self.prompt_bucket % self._draft_ring[2] != 0:
+                raise ValueError(
+                    f"prompt_bucket {self.prompt_bucket} must be a "
+                    f"multiple of the DRAFT model's ring block "
+                    f"({self._draft_ring[2]}): admission prefills the "
+                    "draft cache at the same bucket")
+
         self._pending: deque = deque()
         self._next_id = 0
         self._splice_fn = None
         self._copy_fn = None
+        self._rewind_fn = None
         self._empty_cache_shapes = None
+        self._kv_stats_static = None
 
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
                stream_callback: Optional[Callable] = None,
                deadline_s: Optional[float] = None,
-               replay_tokens: Optional[Sequence[int]] = None) -> int:
+               replay_tokens: Optional[Sequence[int]] = None,
+               kv_handoff: Optional[Any] = None) -> int:
         """Queue one request; returns its request id.
 
         Raises ``QueueFullError`` when the queue is at ``max_pending``,
@@ -278,6 +347,12 @@ class ContinuousBatchingScheduler:
         marks a failover replay (see ``Request.replay_tokens``): the
         stream callback fires only for NEW tokens — the client already
         holds the replayed prefix.
+
+        ``kv_handoff`` is the disaggregated-prefill hand-off (see
+        ``Request.kv_handoff``): admission splices the handed cache
+        instead of prefilling locally. Mutually exclusive with
+        ``replay_tokens`` — a replayed request must re-run its emitted
+        region, which the hand-off by definition has not seen.
         """
         prompt = list(int(t) for t in prompt)
         if not prompt:
@@ -285,6 +360,11 @@ class ContinuousBatchingScheduler:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if kv_handoff is not None and replay_tokens:
+            raise ValueError(
+                "kv_handoff and replay_tokens are mutually exclusive: a "
+                "failover replay must re-run its emitted tokens, which a "
+                "prefill hand-off has not seen")
         replay = [int(t) for t in replay_tokens] if replay_tokens else []
         if replay and len(replay) >= max_new_tokens:
             raise ValueError(
@@ -328,7 +408,8 @@ class ContinuousBatchingScheduler:
                       stream_callback=stream_callback, request_id=rid,
                       t_deadline=(None if deadline_s is None
                                   else now + float(deadline_s)),
-                      replay_tokens=replay or None)
+                      replay_tokens=replay or None,
+                      kv_handoff=kv_handoff)
         if self.journal is not None:
             self.journal.record_submit(
                 rid, prompt, req.max_new_tokens,
@@ -419,15 +500,26 @@ class ContinuousBatchingScheduler:
                 jnp.zeros((1, self._bucketed_len(t_probe)), jnp.int32))
         if eng._prefill_fn is None:
             eng._build_decode_fns()
+        de = self.draft_engine
+        if de is None:
+            return
+        # the draft engine compiles the same way, probed at ITS layout's
+        # minimum trace length (its sparse config may differ)
+        if de._params is None or not hasattr(de, "_param_shardings"):
+            t_probe = self.prompt_bucket
+            sc = getattr(self._draft_mcfg, "sparse_attention", None)
+            nswb = getattr(sc, "num_sliding_window_blocks", None)
+            blk = getattr(sc, "block", None)
+            if nswb and blk:
+                t_probe = max(t_probe, int(nswb) * int(blk))
+            de._materialize(
+                jnp.zeros((1, self._bucketed_len(t_probe)), jnp.int32))
+        if de._prefill_fn is None:
+            de._build_decode_fns()
 
-    def _empty_cache(self):
-        """A ``[slots]``-lane cache with every per-row clock at its virgin
-        value, WITHOUT running the model (a real apply would advance
-        ``cache_index``/``position`` and bake garbage into ``slot_pos``):
-        eval_shape the decode apply for the leaf geometry, then initialize
-        by name — ``slot_pos`` is -1 (no position cached), everything else
-        zeros (``valid`` bools are False, clocks are 0)."""
-        eng = self.engine
+    def _cache_shapes_for(self, eng):
+        """Leaf geometry (jax.eval_shape, nothing materialized) of one
+        ``[slots]``-lane decode cache for ``eng``'s model."""
         model = eng.module
         probe = jnp.zeros((self.slots, 1), jnp.int32)
 
@@ -437,7 +529,28 @@ class ContinuousBatchingScheduler:
                 deterministic=True, decode=True, mutable=["cache"])
             return vars_out["cache"]
 
-        shapes = jax.eval_shape(shape_fn, eng._params)
+        return jax.eval_shape(shape_fn, eng._params)
+
+    def _cache_shapes(self):
+        """The TARGET engine's cache geometry, memoized — `_empty_cache`
+        initializes lanes from it and `kv_cache_stats` accounts resident
+        bytes from it without allocating anything."""
+        if self._empty_cache_shapes is None:
+            self._empty_cache_shapes = self._cache_shapes_for(self.engine)
+        return self._empty_cache_shapes
+
+    def _empty_cache(self, eng=None):
+        """A ``[slots]``-lane cache with every per-row clock at its virgin
+        value, WITHOUT running the model (a real apply would advance
+        ``cache_index``/``position`` and bake garbage into ``slot_pos``):
+        eval_shape the decode apply for the leaf geometry, then initialize
+        by name — ``slot_pos`` is -1 (no position cached), everything else
+        zeros (``valid`` bools are False, clocks are 0). ``eng`` defaults
+        to the target engine; pass the draft engine for its lane cache."""
+        if eng is None or eng is self.engine:
+            shapes = self._cache_shapes()
+        else:
+            shapes = self._cache_shapes_for(eng)
 
         def init_leaf(path, sd):
             name = path[-1].key if hasattr(path[-1], "key") else path[-1]
@@ -480,15 +593,109 @@ class ContinuousBatchingScheduler:
                 lambda t: jax.tree.map(jnp.copy, t))
         return self._copy_fn(tree)
 
+    def _rewind(self, snapshot, cache, delta):
+        """Step every per-row cache clock back by ``delta[B]`` REJECTED
+        tokens, restoring from ``snapshot`` (the copy taken before the
+        speculative pass) every entry those rejected writes clobbered.
+
+        Selective per-slot restore, not a wholesale snapshot swap: the
+        accepted prefix's writes must SURVIVE — they are exactly the
+        writes sequential decode would have made — so a slot is stale
+        (take snapshot) iff its entry was written at a position at or
+        past the new clock: ring caches compare ``slot_pos`` against the
+        new ``cache_index``, dense caches compare the storage position
+        itself (storage index == semantic position). ``cache_index`` and
+        the top-level ``position`` counters step back by delta. Ragged
+        per-lane acceptance is just a ragged ``delta``. Jitted once;
+        only the live cache is donated (each output leaf can reuse at
+        most one input buffer, so donating the snapshot too would just
+        warn)."""
+        if self._rewind_fn is None:
+            from collections.abc import Mapping
+
+            def rewind(c0, c1, d):
+                def rewind_attn(a0, a1):
+                    ci = a1["cache_index"]
+                    # ci is [B] ([L, B] under nn.scan); d broadcasts up
+                    idx_new = ci - d.astype(ci.dtype)
+                    if "slot_pos" in a1:
+                        stale = a1["slot_pos"] >= idx_new[..., None]
+                    else:
+                        s_len = a1["cached_key"].shape[-3]
+                        pos = jnp.arange(s_len, dtype=ci.dtype)
+                        stale = pos >= idx_new[..., None]
+                    out = {}
+                    for k in a1:
+                        if k == "cache_index":
+                            out[k] = idx_new
+                            continue
+                        v0, v1 = a0[k], a1[k]
+                        m = stale.reshape(
+                            stale.shape + (1,) * (v1.ndim - stale.ndim))
+                        out[k] = jnp.where(m, v0, v1)
+                    return out
+
+                def walk(t0, t1, top):
+                    out = {}
+                    for k in t1:
+                        v1 = t1[k]
+                        if isinstance(v1, Mapping):
+                            if "cache_index" in v1:
+                                out[k] = rewind_attn(t0[k], v1)
+                            else:
+                                out[k] = walk(t0[k], v1, False)
+                        elif top and k == "position":
+                            out[k] = v1 - d.astype(v1.dtype)
+                        else:
+                            out[k] = v1
+                    return out
+
+                return walk(c0, c1, True)
+
+            self._rewind_fn = jax.jit(rewind, donate_argnums=(1,))
+        return self._rewind_fn(snapshot, cache, delta)
+
+    def _draft_prefill(self, ids: np.ndarray, mask: np.ndarray,
+                       req: Request):
+        """Chunked prefill of the DRAFT model's cache for one admission
+        (logits discarded — the draft only proposes from decode steps).
+        Replays run the same continuation spans so a failed-over
+        request's draft clock lands where its target clock does."""
+        de = self.draft_engine
+        _, sub = de._chunked_prefill(jnp.asarray(ids), jnp.asarray(mask))
+        if req.replay_tokens:
+            Lp = ids.shape[1]
+            E = len(req.replay_tokens)
+            rep_ids = np.asarray([req.replay_tokens], np.int32)
+            rep_mask = np.ones((1, E), bool)
+            for s, e in continuation_chunk_spans(self._draft_mcfg,
+                                                 Lp, Lp + E):
+                _, sub = de._prefill_more_fn(
+                    de._params, jnp.asarray(rep_ids[:, s - Lp:e - Lp]),
+                    jnp.asarray(rep_mask[:, s - Lp:e - Lp]), sub)
+        return sub
+
     def _admit_prefill(self, req: Request):
         """Exact (chunked when needed) prefill of one prompt on a
-        ``[1, Lp]`` batch; returns (first sampled token, sub cache)."""
+        ``[1, Lp]`` batch; returns (first sampled token, sub cache,
+        draft sub cache — None without speculative decoding)."""
         eng = self.engine
         Lp = self._bucketed_len(len(req.prompt))
         ids = np.zeros((1, Lp), np.int32)
         mask = np.zeros((1, Lp), bool)
         ids[0, Lp - len(req.prompt):] = req.prompt
         mask[0, Lp - len(req.prompt):] = True
+        # the draft cache is ALWAYS built locally — a hand-off carries
+        # only the target cache (the draft is a decode-side accessory)
+        draft_sub = (self._draft_prefill(ids, mask, req)
+                     if self.draft_engine is not None else None)
+        if req.kv_handoff is not None:
+            # disaggregated hand-off: a prefill replica already ran this
+            # prompt's exact chunked prefill at the same bucket. Copy
+            # before splicing — the producer may fan the same entry out
+            # to several decode lanes, and _splice donates.
+            first_tok, sub_cache = req.kv_handoff
+            return int(first_tok), self._copy_tree(sub_cache), draft_sub
         if self.prefix_cache is not None:
             logits_last, sub_cache = self._prefix_prefill(
                 ids, mask, req.request_id)
@@ -514,7 +721,7 @@ class ContinuousBatchingScheduler:
                 sub, logits_last / self.temperature, axis=-1)
         else:
             tok = jnp.argmax(logits_last, axis=-1)
-        return int(np.asarray(tok)[0]), sub_cache
+        return int(np.asarray(tok)[0]), sub_cache, draft_sub
 
     def _prefix_prefill(self, ids: np.ndarray, mask: np.ndarray,
                         request_id):
@@ -574,6 +781,62 @@ class ContinuousBatchingScheduler:
                     jnp.asarray(mask[:, s:e]), cache)
         return logits_last, cache
 
+    def kv_cache_stats(self, hbm_override_gib: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        """KV-cache byte accounting from the memoized leaf geometry.
+
+        ``resident_bytes`` is what THIS cache actually stores (int8
+        payloads plus their f32 scale sidebands when kv_cache_dtype is
+        "int8"); ``unquantized_bytes`` is the compute-dtype twin — the
+        same geometry with ``cached_key``/``cached_value`` at the model
+        dtype and no sidebands. Their ratio is the honest compression
+        factor, and with a known HBM size (telemetry/memory.hbm_bytes)
+        ``lanes_at_hbm_budget`` says how many decode lanes of THIS
+        per-lane footprint fit the part — the capacity number the
+        disaggregated-serving sizing tables are built from."""
+        from deepspeed_tpu.telemetry.memory import hbm_bytes
+
+        if self._kv_stats_static is None:
+            shapes = self._cache_shapes()
+            compute_dt = jnp.dtype(getattr(self._mcfg, "dtype",
+                                           jnp.float32))
+            resident = 0
+            unquant = 0
+
+            def acc(path, sd):
+                nonlocal resident, unquant
+                name = path[-1].key if hasattr(path[-1], "key") \
+                    else path[-1]
+                nbytes = sd.size * jnp.dtype(sd.dtype).itemsize
+                resident += nbytes
+                if name in ("cached_key", "cached_value"):
+                    unquant += sd.size * compute_dt.itemsize
+                elif name in ("cached_key_scale", "cached_value_scale"):
+                    pass  # sideband of the int8 store; the twin has none
+                else:
+                    unquant += nbytes
+
+            jax.tree_util.tree_map_with_path(acc, shapes)
+            self._kv_stats_static = {
+                "kv_cache_dtype": (getattr(self._mcfg, "kv_cache_dtype",
+                                           None) or "compute"),
+                "resident_bytes": int(resident),
+                "unquantized_bytes": int(unquant),
+                "bytes_per_lane": int(resident // self.slots),
+                "lanes": self.slots,
+                "compression_ratio": (float(unquant) / float(resident)
+                                      if resident else 1.0),
+            }
+        out = dict(self._kv_stats_static)
+        hbm, source = hbm_bytes(override_gib=hbm_override_gib)
+        if hbm:
+            out["hbm_bytes"] = int(hbm)
+            out["hbm_source"] = source
+            per_lane = out["bytes_per_lane"]
+            out["lanes_at_hbm_budget"] = (int(hbm // per_lane)
+                                          if per_lane else 0)
+        return out
+
     def frontdoor_stats(self) -> Dict[str, Any]:
         """Shed + prefix-cache + health counters for benches/servers."""
         out: Dict[str, Any] = {"shed": self.shed_count,
@@ -591,6 +854,18 @@ class ContinuousBatchingScheduler:
         if self.health_provider is not None and \
                 hasattr(self.health_provider, "states"):
             out["health"] = dict(self.health_provider.states())
+        if self.draft_engine is not None:
+            out["spec"] = {
+                "k": self.spec_k,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else 0.0)}
+        # gated on the geometry already being traced (run() does it):
+        # frontdoor_stats must stay safe on fake/unmaterialized engines
+        if self._empty_cache_shapes is not None or \
+                self._kv_stats_static is not None:
+            out["kv_cache"] = self.kv_cache_stats()
         return out
 
     def _publish_stats(self, stats: "ServingStats", lanes) -> None:
@@ -614,6 +889,10 @@ class ContinuousBatchingScheduler:
         if self.health_provider is not None and \
                 hasattr(self.health_provider, "states"):
             payload["health"] = dict(self.health_provider.states())
+        if self._empty_cache_shapes is not None:
+            kv = self.kv_cache_stats()
+            payload["kv_resident_bytes"] = kv["resident_bytes"]
+            payload["kv_unquantized_bytes"] = kv["unquantized_bytes"]
         publish(KIND_SERVE_STATS, **payload)
 
     # ------------------------------------------------------------------
@@ -639,12 +918,19 @@ class ContinuousBatchingScheduler:
         cache = self._empty_cache()
         eng._rng, rng = jax.random.split(eng._rng)
         temp = jnp.float32(self.temperature)
+        use_spec = self.draft_engine is not None
+        draft_cache = draft_rng = None
+        if use_spec:
+            de = self.draft_engine
+            draft_cache = self._empty_cache(de)
+            de._rng, draft_rng = jax.random.split(de._rng)
         t_run0 = time.monotonic()
 
         from deepspeed_tpu.telemetry.bus import (
             KIND_SERVE_ADMIT,
             KIND_SERVE_EVICT,
             KIND_SERVE_FIRST_TOKEN,
+            KIND_SERVE_SPEC_ACCEPT,
             publish,
         )
 
@@ -712,8 +998,12 @@ class ContinuousBatchingScheduler:
                             replayed=replayed,
                             queue_wait_s=comp.t_admit - t_submit,
                             queue_depth=len(self._pending))
-                    first_tok, sub_cache = self._admit_prefill(req)
+                    first_tok, sub_cache, draft_sub = \
+                        self._admit_prefill(req)
                     cache = self._splice(cache, sub_cache, lane_no)
+                    if draft_sub is not None:
+                        draft_cache = self._splice(
+                            draft_cache, draft_sub, lane_no)
                     tok[lane_no] = first_tok
                     lane = _Lane(req=req, comp=comp, emitted=replayed)
                     lanes[lane_no] = lane
@@ -724,18 +1014,69 @@ class ContinuousBatchingScheduler:
             if not any(l is not None for l in lanes):
                 continue  # everything admitted finished at token 1
 
-            # ONE fixed-shape decode step for all lanes (garbage lanes
-            # included — row-independent attention keeps them harmless)
-            toks, _, cache, rng = eng._decode_k_fn(
-                eng._params, jnp.asarray(tok), cache, rng, temp, 1)
-            stats.decode_steps += 1
-            tok = np.asarray(toks[:, 0]).astype(np.int32).copy()
-            for lane_no in range(self.slots):
-                lane = lanes[lane_no]
-                if lane is None:
-                    continue
-                if emit(lane_no, lane, int(tok[lane_no])):
-                    finish(lane_no, lane)
+            if use_spec:
+                # speculative step: the draft proposes k greedy tokens
+                # per lane (k sequential cheap steps), the target
+                # verifies them in ONE [slots, k+1] forward, and both
+                # caches rewind past each lane's first mismatch.
+                # m_eff = min(m, k-1): no bonus token — accepting all k
+                # would need the draft's k-th proposal in ITS cache,
+                # which the proposal loop never wrote. Every emitted
+                # token is a target argmax given the emitted prefix, so
+                # the stream is exactly sequential greedy.
+                k = self.spec_k
+                de = self.draft_engine
+                snap = self._copy_tree(cache)
+                draft_snap = self._copy_tree(draft_cache)
+                props, _, draft_cache, draft_rng = de._decode_k_fn(
+                    de._params, jnp.asarray(tok), draft_cache, draft_rng,
+                    jnp.float32(0.0), k)
+                cols = jnp.concatenate(
+                    [jnp.asarray(tok)[:, None], props], axis=1)
+                g, cache = eng._verify_greedy_fn(eng._params, cols, cache)
+                stats.decode_steps += 1
+                g_np = np.asarray(g)
+                props_np = np.asarray(props)
+                matches = props_np == g_np[:, :k]
+                m = np.where(matches.all(axis=1), k,
+                             matches.argmin(axis=1))
+                m_eff = np.minimum(m, k - 1).astype(np.int64)
+                cache = self._rewind(
+                    snap, cache, jnp.asarray((k - m_eff).astype(np.int32)))
+                draft_cache = self._rewind(
+                    draft_snap, draft_cache,
+                    jnp.asarray((k - 1 - m_eff).astype(np.int32)))
+                live = [ln for ln in range(self.slots)
+                        if lanes[ln] is not None]
+                self.spec_proposed += k * len(live)
+                accepted_now = int(sum(int(m_eff[ln]) for ln in live))
+                self.spec_accepted += accepted_now
+                publish(KIND_SERVE_SPEC_ACCEPT, k=k, lanes=len(live),
+                        proposed=k * len(live), accepted=accepted_now,
+                        proposed_total=self.spec_proposed,
+                        accepted_total=self.spec_accepted)
+                for lane_no in live:
+                    lane = lanes[lane_no]
+                    for j in range(int(m_eff[lane_no]) + 1):
+                        if emit(lane_no, lane, int(g_np[lane_no, j])):
+                            finish(lane_no, lane)
+                            break
+                tok = g_np[np.arange(self.slots), m_eff] \
+                    .astype(np.int32).copy()
+            else:
+                # ONE fixed-shape decode step for all lanes (garbage
+                # lanes included — row-independent attention keeps them
+                # harmless)
+                toks, _, cache, rng = eng._decode_k_fn(
+                    eng._params, jnp.asarray(tok), cache, rng, temp, 1)
+                stats.decode_steps += 1
+                tok = np.asarray(toks[:, 0]).astype(np.int32).copy()
+                for lane_no in range(self.slots):
+                    lane = lanes[lane_no]
+                    if lane is None:
+                        continue
+                    if emit(lane_no, lane, int(tok[lane_no])):
+                        finish(lane_no, lane)
 
         stats.wall_s = time.monotonic() - t_run0
         return stats

@@ -130,6 +130,25 @@ class GPTConfig:
     # leaves shard like the dense kernel they replace, see
     # runtime/zero/sharding.py _quantized_leaf_spec).
     quantized_weights: bool = False
+    # int8 KV cache for decode (serving capacity lever, see
+    # serving/disagg.py): cache leaves are STORED int8 with one f32 scale
+    # per (row, slot, kv-head) — the same symmetric blockwise format as
+    # the compressed wire (ops/quantizer.quantize_blockwise, block =
+    # head_dim) — and dequantized on read inside the attention einsum.
+    # Per-slot HBM drops from 2*D*2 bytes (bf16) to 2*(D + 4) bytes,
+    # ~1.94x more lanes at D=128 under the same budget (~3.88x vs fp32).
+    # None keeps the cache in the compute dtype; "int8" quantizes. The
+    # cache PROTOCOL (leaf shapes minus dtype, splice axes, slot clocks)
+    # is unchanged, so the scheduler's jitted _splice and the prefix
+    # cache work as-is.
+    kv_cache_dtype: Any = None
+    # extra STORAGE blocks in the ring KV cache beyond the w_blk + 1 the
+    # window visibility needs (sparse_attention_utils.ring_storage_len).
+    # Semantically invisible — visibility is positional — but >= 1 makes
+    # the speculative-decode verify pass (an unaligned multi-token
+    # mid-stream write) exact; the continuous-batching scheduler demands
+    # it when spec decoding a ring model.
+    kv_cache_slack_blocks: int = 0
     # stochastic transformer (reference op_builder/stochastic_transformer.py,
     # ops/transformer/transformer.py:110 stochastic_mode): whole-block
     # stochastic depth. When training under a progressive-layer-drop
@@ -185,6 +204,15 @@ class GPTConfig:
             raise ValueError(
                 f"attention_chunk must be a positive int or None; got "
                 f"{self.attention_chunk!r}")
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None or 'int8'; got "
+                f"{self.kv_cache_dtype!r}")
+        if not isinstance(self.kv_cache_slack_blocks, int) or \
+                self.kv_cache_slack_blocks < 0:
+            raise ValueError(
+                f"kv_cache_slack_blocks must be a non-negative int; got "
+                f"{self.kv_cache_slack_blocks!r}")
         if self.sparse_kv_cache not in ("auto", True, False):
             raise ValueError(
                 f"sparse_kv_cache must be 'auto', True or False; got "
@@ -392,6 +420,27 @@ class CausalSelfAttention(nn.Module):
             if not cfg.causal:
                 raise NotImplementedError(
                     "decode path requires a causal model")
+            # int8 KV cache (GPTConfig.kv_cache_dtype): values are stored
+            # quantized with per-(row, slot, kv-head) f32 scales and
+            # dequantized on read — XLA fuses the int8->f32 convert +
+            # scale multiply into the attention einsums, so per-step HBM
+            # cache traffic stays int8
+            kv_int8 = cfg.kv_cache_dtype == "int8"
+            kv_store_dtype = jnp.int8 if kv_int8 else cfg.dtype
+
+            def quantize_kv(t):
+                from deepspeed_tpu.ops.quantizer import quantize_blockwise
+
+                q, s = quantize_blockwise(t, D)
+                return q, s[..., 0]          # [B, T, Hkv, 1] -> [B, T, Hkv]
+
+            def read_kv(ck, cv, ks, vs):
+                if not kv_int8:
+                    return ck.value, cv.value
+                from deepspeed_tpu.ops.quantizer import dequantize_blockwise
+
+                return (dequantize_blockwise(ck.value, ks.value, cfg.dtype),
+                        dequantize_blockwise(cv.value, vs.value, cfg.dtype))
             # layout-aware compact KV cache: when the sparse layout is a
             # causal window (+ leading globals), decode retains ONLY the
             # slots the layout can ever attend — a block-granular ring —
@@ -399,12 +448,12 @@ class CausalSelfAttention(nn.Module):
             # (the dense-cache path below attends strictly more keys than
             # a window-trained model saw). See GPTConfig.sparse_kv_cache.
             from deepspeed_tpu.ops.sparse_attention. \
-                sparse_attention_utils import ring_engaged
+                sparse_attention_utils import ring_engaged, ring_storage_len
 
             ring = ring_engaged(cfg)
             if ring is not None:
                 w_blk, g_tok, blk = ring
-                ring_len = (w_blk + 1) * blk
+                ring_len = ring_storage_len(cfg, ring)
                 S = g_tok + ring_len
                 if T > ring_len:
                     raise ValueError(
@@ -420,10 +469,18 @@ class CausalSelfAttention(nn.Module):
                         "(inference/engine.py prefill_chunk_spans).")
                 cached_k = self.variable(
                     "cache", "cached_key", jnp.zeros,
-                    (B, S, Hkv, D), cfg.dtype)
+                    (B, S, Hkv, D), kv_store_dtype)
                 cached_v = self.variable(
                     "cache", "cached_value", jnp.zeros,
-                    (B, S, Hkv, D), cfg.dtype)
+                    (B, S, Hkv, D), kv_store_dtype)
+                k_scale = v_scale = None
+                if kv_int8:
+                    k_scale = self.variable(
+                        "cache", "cached_key_scale", jnp.zeros,
+                        (B, S, Hkv), jnp.float32)
+                    v_scale = self.variable(
+                        "cache", "cached_value_scale", jnp.zeros,
+                        (B, S, Hkv), jnp.float32)
                 cache_valid = self.variable(
                     "cache", "valid", jnp.zeros, (B, S), jnp.bool_)
                 # PER-ROW slot positions and write index: continuous-
@@ -448,19 +505,27 @@ class CausalSelfAttention(nn.Module):
                 glob_slot = jnp.where(pos < g_tok, pos, S)    # S -> dropped
                 write_valid = (mask.astype(jnp.bool_) if mask is not None
                                else jnp.ones((B, T), jnp.bool_))
-                kc, vc = k.astype(cfg.dtype), v.astype(cfg.dtype)
+                if kv_int8:
+                    (kc, ksc), (vc, vsc) = quantize_kv(k), quantize_kv(v)
+                else:
+                    kc, vc = k.astype(cfg.dtype), v.astype(cfg.dtype)
                 rows = jnp.arange(B)[:, None]
                 for slots in (ring_slot, glob_slot):
                     cached_k.value = cached_k.value.at[rows, slots].set(
                         kc, mode="drop")
                     cached_v.value = cached_v.value.at[rows, slots].set(
                         vc, mode="drop")
+                    if kv_int8:
+                        k_scale.value = k_scale.value.at[rows, slots].set(
+                            ksc, mode="drop")
+                        v_scale.value = v_scale.value.at[rows, slots].set(
+                            vsc, mode="drop")
                     cache_valid.value = cache_valid.value.at[
                         rows, slots].set(write_valid, mode="drop")
                     slot_pos.value = slot_pos.value.at[rows, slots].set(
                         pos, mode="drop")
                 cache_index.value = idx + T
-                k_all, v_all = cached_k.value, cached_v.value
+                k_all, v_all = read_kv(cached_k, cached_v, k_scale, v_scale)
 
                 G = H // Hkv
                 qg = q.reshape(B, T, Hkv, G, D)
@@ -499,10 +564,18 @@ class CausalSelfAttention(nn.Module):
             # exact without per-sequence position bookkeeping here.
             cached_k = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (B, cfg.n_positions, Hkv, D), cfg.dtype)
+                (B, cfg.n_positions, Hkv, D), kv_store_dtype)
             cached_v = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (B, cfg.n_positions, Hkv, D), cfg.dtype)
+                (B, cfg.n_positions, Hkv, D), kv_store_dtype)
+            k_scale = v_scale = None
+            if kv_int8:
+                k_scale = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros,
+                    (B, cfg.n_positions, Hkv), jnp.float32)
+                v_scale = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros,
+                    (B, cfg.n_positions, Hkv), jnp.float32)
             cache_valid = self.variable(
                 "cache", "valid", jnp.zeros,
                 (B, cfg.n_positions), jnp.bool_)
@@ -520,16 +593,24 @@ class CausalSelfAttention(nn.Module):
                 # after its apply_rotary_pos_emb kernel
                 q, k = rope(q, pos), rope(k, pos)
             rows = jnp.arange(B)[:, None]
+            if kv_int8:
+                (kc, ksc), (vc, vsc) = quantize_kv(k), quantize_kv(v)
+                k_scale.value = k_scale.value.at[rows, pos].set(
+                    ksc, mode="drop")
+                v_scale.value = v_scale.value.at[rows, pos].set(
+                    vsc, mode="drop")
+            else:
+                kc, vc = k.astype(cfg.dtype), v.astype(cfg.dtype)
             cached_k.value = cached_k.value.at[rows, pos].set(
-                k.astype(cfg.dtype), mode="drop")
+                kc, mode="drop")
             cached_v.value = cached_v.value.at[rows, pos].set(
-                v.astype(cfg.dtype), mode="drop")
+                vc, mode="drop")
             write_valid = (mask.astype(jnp.bool_) if mask is not None
                            else jnp.ones((B, T), jnp.bool_))
             cache_valid.value = cache_valid.value.at[rows, pos].set(
                 write_valid, mode="drop")
             cache_index.value = idx + T
-            k_all, v_all = cached_k.value, cached_v.value
+            k_all, v_all = read_kv(cached_k, cached_v, k_scale, v_scale)
 
             # grouped attention: query heads contract directly against the
             # un-repeated KV cache ([B, max, Hkv, D] stays in place — no

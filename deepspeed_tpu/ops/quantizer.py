@@ -87,6 +87,32 @@ def dequantize(
     return out.reshape(orig_shape).astype(dtype)
 
 
+def quantize_blockwise(x: jnp.ndarray, block: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 with one f32 scale per contiguous ``block`` elements
+    of the trailing axis — the shared format for the compressed wire
+    (``comm/compressed.py``) and the int8 KV cache (serving).
+
+    Returns ``(q, scale)``: ``q`` int8 in ``x``'s shape, ``scale`` float32
+    shaped ``x.shape[:-1] + (x.shape[-1] // block,)`` so callers can index
+    scales alongside the values they describe (e.g. per ``[B, S, H]`` cache
+    slot when ``block == head_dim``).
+    """
+    assert x.shape[-1] % block == 0, (
+        f"trailing axis {x.shape[-1]} not divisible by block {block}")
+    q, scale, _ = quantize(x, num_bits=8, num_groups=x.size // block,
+                           symmetric=True)
+    return q, scale.reshape(x.shape[:-1] + (x.shape[-1] // block,))
+
+
+def dequantize_blockwise(q: jnp.ndarray, scale: jnp.ndarray,
+                         dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blockwise` (symmetric, so just a
+    broadcast multiply — no zero point)."""
+    g = q.reshape(scale.shape + (-1,)).astype(jnp.float32)
+    return (g * scale[..., None]).reshape(q.shape).astype(dtype)
+
+
 def fake_quantize(x, num_bits=8, num_groups=1, symmetric=True,
                   stochastic=False, rng=None):
     """Quantize-dequantize round trip in the input dtype (what MoQ applies to

@@ -213,6 +213,25 @@ def ring_engaged(model_cfg):
     return ring
 
 
+def ring_storage_len(model_cfg, ring) -> int:
+    """Physical ring capacity in tokens: the ``w_blk + 1`` blocks decode
+    visibility needs, plus ``kv_cache_slack_blocks`` extra STORAGE blocks.
+
+    Slack is semantically invisible — visibility is positional (an
+    entry's ``slot_pos`` against the query's window), so extra blocks
+    only delay overwrite — but it is what makes an UNALIGNED multi-token
+    mid-stream pass exact: with one slack block, a pass of at most
+    ``block`` tokens can never evict an entry that any of its own
+    columns (or any post-rewind query) still needs. The speculative-
+    decode verify forward (inference/scheduler.py) is exactly such a
+    pass; chunked prefill instead splits at block boundaries and needs
+    no slack. The ONE definition of ring storage size — the model's
+    cache allocation and the engine's span math both call this."""
+    w_blk, g_tok, blk = ring
+    slack = int(getattr(model_cfg, "kv_cache_slack_blocks", 0) or 0)
+    return (w_blk + 1 + slack) * blk
+
+
 # Newest-last reasons every time an EXPLICIT sparse_kv_cache=True was
 # declined (test/debug hook for the warn-and-record below; "auto" declines
 # stay silent — auto means "ring only when it helps").

@@ -44,11 +44,19 @@ from deepspeed_tpu.serving.fleet import (
     ReplicaDead,
     RequestJournal,
 )
+from deepspeed_tpu.serving.disagg import (
+    DisaggServer,
+    KVHandoff,
+    PrefillWorker,
+    lane_kv_bytes,
+)
 from deepspeed_tpu.serving.prefix_cache import (
     PrefixCache,
     PrefixCacheConfig,
 )
 from deepspeed_tpu.serving.router import (
+    ROLE_DECODE,
+    ROLE_PREFILL,
     NoLiveReplicasError,
     PrefixRouter,
     route_trace,
@@ -60,6 +68,7 @@ __all__ = [
     "ContinuousBatchingScheduler",
     "DOWN",
     "DeadlineExceededError",
+    "DisaggServer",
     "DrainingError",
     "FleetCoordinator",
     "FleetHealth",
@@ -67,18 +76,23 @@ __all__ = [
     "HEALTHY",
     "HealthConfig",
     "JournalEntry",
+    "KVHandoff",
     "NoLiveReplicasError",
     "PrefixCache",
     "PrefixCacheConfig",
     "PrefixRouter",
+    "PrefillWorker",
     "QueueFullError",
     "RECOVERING",
+    "ROLE_DECODE",
+    "ROLE_PREFILL",
     "ReplicaDead",
     "RequestJournal",
     "RequestShedError",
     "SLOAdmissionController",
     "SUSPECT",
     "build_serving",
+    "lane_kv_bytes",
     "route_trace",
 ]
 
@@ -97,7 +111,8 @@ def _default_align(engine, prompt_bucket: Optional[int]) -> int:
 
 
 def build_serving(engine, config: Optional[Dict[str, Any]] = None,
-                  reject_callback=None) -> ContinuousBatchingScheduler:
+                  reject_callback=None,
+                  draft_engine=None) -> ContinuousBatchingScheduler:
     """Assemble the front door from one config dict::
 
         build_serving(engine, {
@@ -109,12 +124,15 @@ def build_serving(engine, config: Optional[Dict[str, Any]] = None,
                              "budget_bytes": 512 << 20},
             "admission": {"slo_ttft_p95_s": 2.0, "window": 64},
             "journal": True,
+            "spec_k": 4,   # with draft_engine=: speculative decoding
         })
 
     ``prefix_cache``/``admission``/``journal`` accept a knob dict,
     ``True`` (all defaults), or ``False``/absent (off). Unknown keys
     raise — a typo'd knob silently running with defaults is how SLOs
-    get missed.
+    get missed. ``draft_engine`` (parameter, not a config key — it is a
+    live engine, not a knob) plus ``spec_k`` turn on exact-greedy
+    speculative decoding in the scheduler.
     """
     cfg = dict(config or {})
     slots = int(cfg.pop("slots", 8))
@@ -122,6 +140,7 @@ def build_serving(engine, config: Optional[Dict[str, Any]] = None,
     temperature = float(cfg.pop("temperature", 0.0))
     eos_token_id = cfg.pop("eos_token_id", None)
     max_pending = cfg.pop("max_pending", None)
+    spec_k = int(cfg.pop("spec_k", 0))
     pc_cfg = cfg.pop("prefix_cache", False)
     adm_cfg = cfg.pop("admission", False)
     journal_cfg = cfg.pop("journal", False)
@@ -149,4 +168,4 @@ def build_serving(engine, config: Optional[Dict[str, Any]] = None,
         temperature=temperature, eos_token_id=eos_token_id,
         max_pending=max_pending, prefix_cache=prefix_cache,
         admission_controller=admission, reject_callback=reject_callback,
-        journal=journal)
+        journal=journal, draft_engine=draft_engine, spec_k=spec_k)

@@ -59,9 +59,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from deepspeed_tpu.serving.router import ROLE_DECODE, ROLE_PREFILL
 from deepspeed_tpu.telemetry.bus import (
     KIND_SERVE_DRAIN,
     KIND_SERVE_FAILOVER,
+    KIND_SERVE_KV_TRANSFER,
     KIND_SERVE_REPLICA_DOWN,
     KIND_SERVE_REPLICA_UP,
     telemetry_bus,
@@ -367,7 +369,8 @@ class FleetCoordinator:
 
     def __init__(self, router, health: Optional[FleetHealth] = None,
                  journal: Optional[RequestJournal] = None,
-                 clock: Callable[[], float] = time.monotonic, bus=None):
+                 clock: Callable[[], float] = time.monotonic, bus=None,
+                 roles: Optional[Sequence[str]] = None):
         self.router = router
         self._clock = clock
         self._bus = bus if bus is not None else telemetry_bus
@@ -375,20 +378,101 @@ class FleetCoordinator:
             router.n_replicas, clock=clock, bus=self._bus)
         self.journal = journal if journal is not None else \
             RequestJournal(clock=clock)
+        # role-aware placement (disaggregated serving): the fleet keeps
+        # GLOBAL replica indices — health, journal depths, and telemetry
+        # all speak them — but routes each kind of traffic over a
+        # pool-local sub-router so prefill replicas never take decode
+        # lanes (and vice versa). The sub-routers share the main
+        # router's align/spill_slack so affinity behaves identically.
+        self.roles: Optional[List[str]] = None
+        self._decode_pool = list(range(router.n_replicas))
+        self._prefill_pool: List[int] = []
+        self._decode_router = router
+        self._prefill_router = None
+        self.kv_transfers = 0
+        self.kv_bytes = 0
+        if roles is not None:
+            roles = [str(r) for r in roles]
+            if len(roles) != router.n_replicas:
+                raise ValueError(
+                    f"got {len(roles)} roles for "
+                    f"{router.n_replicas} replicas")
+            bad = set(roles) - {ROLE_PREFILL, ROLE_DECODE}
+            if bad:
+                raise ValueError(
+                    f"unknown replica roles {sorted(bad)}; choose from "
+                    f"('{ROLE_PREFILL}', '{ROLE_DECODE}')")
+            self.roles = roles
+            self._decode_pool = [i for i, r in enumerate(roles)
+                                 if r == ROLE_DECODE]
+            self._prefill_pool = [i for i, r in enumerate(roles)
+                                  if r == ROLE_PREFILL]
+            if not self._decode_pool:
+                raise ValueError(
+                    "a fleet needs at least one decode replica")
+            mk = type(router)
+            self._decode_router = mk(len(self._decode_pool),
+                                     align=router.align,
+                                     spill_slack=router.spill_slack)
+            if self._prefill_pool:
+                self._prefill_router = mk(len(self._prefill_pool),
+                                          align=router.align,
+                                          spill_slack=router.spill_slack)
+
+    def _pool_route(self, pool: List[int], sub_router, prompt
+                    ) -> Tuple[int, str]:
+        """Route over one role pool; returns the GLOBAL replica index.
+        Depth and liveness vectors are global — sliced down to the pool
+        so a busy prefill replica never biases decode spill decisions."""
+        self.health.sweep()
+        depths = self.journal.depths(self.router.n_replicas)
+        live = self.health.live()
+        local, how = sub_router.route(
+            prompt, [depths[i] for i in pool],
+            live=[live[i] for i in pool])
+        return pool[local], how
 
     def place(self, request_id, prompt: Sequence[int], max_new_tokens: int,
               deadline_s: Optional[float] = None) -> Tuple[int, str]:
-        """Route one request over live replicas and journal it; returns
-        ``(replica, 'affine'|'spill'|'failover')``."""
-        self.health.sweep()
-        depths = self.journal.depths(self.router.n_replicas)
-        replica, how = self.router.route(prompt, depths,
-                                         live=self.health.live())
+        """Route one request over live DECODE replicas and journal it;
+        returns ``(replica, 'affine'|'spill'|'failover')``."""
+        replica, how = self._pool_route(self._decode_pool,
+                                        self._decode_router, prompt)
         deadline = None if deadline_s is None else \
             self._clock() + float(deadline_s)
         self.journal.record_submit(request_id, prompt, max_new_tokens,
                                    replica=replica, deadline=deadline)
         return replica, how
+
+    def place_prefill(self, prompt: Sequence[int]) -> Tuple[int, str]:
+        """Route one PREFILL job over the live prefill replicas (hash
+        affinity keeps a tenant's shared prefix warm on its prefill
+        home, same as decode affinity). Not journaled — the flight
+        record belongs to the decode placement; the prefill replica's
+        output is a KV hand-off, not client tokens."""
+        if not self._prefill_pool:
+            raise ValueError(
+                "this fleet has no prefill replicas (construct "
+                "FleetCoordinator with roles=[...ROLE_PREFILL...])")
+        return self._pool_route(self._prefill_pool,
+                                self._prefill_router, prompt)
+
+    def record_kv_transfer(self, request_id, from_replica: int,
+                           to_replica: int, nbytes: int,
+                           transfer_s: Optional[float] = None) -> None:
+        """Account one prefill->decode KV hand-off and publish
+        ``serve.kv_transfer`` — the wire-cost ledger of disaggregation
+        (int8 KV shrinks exactly this number)."""
+        self.kv_transfers += 1
+        self.kv_bytes += int(nbytes)
+        payload: Dict[str, Any] = dict(
+            request_id=request_id, from_replica=int(from_replica),
+            to_replica=int(to_replica), bytes=int(nbytes),
+            transfers_total=self.kv_transfers,
+            bytes_total=self.kv_bytes)
+        if transfer_s is not None:
+            payload["transfer_s"] = float(transfer_s)
+        self._bus.publish(KIND_SERVE_KV_TRANSFER, **payload)
 
     def on_token(self, request_id, token: int, done: bool = False) -> None:
         self.journal.record_token(request_id, token, done=done)
@@ -407,9 +491,8 @@ class FleetCoordinator:
         moved: List[Tuple[Any, int, Dict[str, Any]]] = []
         for e in self.journal.inflight(replica=replica):
             spec = self.journal.replay_spec(e.request_id)
-            depths = self.journal.depths(self.router.n_replicas)
-            target, _how = self.router.route(e.prompt, depths,
-                                             live=self.health.live())
+            target, _how = self._pool_route(self._decode_pool,
+                                            self._decode_router, e.prompt)
             self.journal.reassign(e.request_id, target)
             self._bus.publish(
                 KIND_SERVE_FAILOVER, severity="warning",
@@ -421,10 +504,17 @@ class FleetCoordinator:
         return moved
 
     def stats(self) -> Dict[str, Any]:
-        return {"health": {str(k): v for k, v in
-                           self.health.states().items()},
-                "journal": self.journal.stats(),
-                "router": self.router.stats()}
+        out = {"health": {str(k): v for k, v in
+                          self.health.states().items()},
+               "journal": self.journal.stats(),
+               "router": self._decode_router.stats()}
+        if self.roles is not None:
+            out["roles"] = list(self.roles)
+            out["kv_transfer"] = {"transfers": self.kv_transfers,
+                                  "bytes": self.kv_bytes}
+            if self._prefill_router is not None:
+                out["prefill_router"] = self._prefill_router.stats()
+        return out
 
 
 # ---------------------------------------------------------------------

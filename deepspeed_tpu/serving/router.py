@@ -17,7 +17,16 @@ processes over pipes; unit tests drive it with synthetic depths.
 """
 
 import zlib
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+# Replica roles for disaggregated serving (serving/disagg.py): a
+# ``prefill`` replica runs chunked prompt prefills and hands the KV off;
+# a ``decode`` replica runs the continuous-batching token loop. The
+# router only ever places DECODE traffic, so role-aware call sites fold
+# prefill replicas out of the candidate set (route_trace below;
+# FleetCoordinator keeps pool-local sub-routers).
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
 
 
 class NoLiveReplicasError(RuntimeError):
@@ -92,14 +101,53 @@ class PrefixRouter:
 
 
 def route_trace(router: PrefixRouter, prompts: List[Sequence[int]],
-                costs: Sequence[int] = None) -> List[int]:
+                costs: Sequence[int] = None,
+                live: Union[Sequence[bool],
+                            Callable[[int], Sequence[bool]], None] = None,
+                roles: Optional[Sequence[str]] = None) -> List[int]:
     """Assign a whole trace against simulated depths (each routed request
-    deepens its replica by its cost; default 1). Used by the bench to
-    report affinity/spill rates without spawning processes."""
+    deepens its replica by its cost; default 1). Used by the benches to
+    report affinity/spill/failover rates without spawning processes.
+
+    The simulation used to silently skip ``route()``'s liveness and role
+    machinery, so the failover branch was untestable offline:
+
+    * ``live`` — a fixed mask, or a callable ``live(step) -> mask`` to
+      script an outage mid-trace (replica dies at step 40, recovers at
+      80). Dead replicas take nothing; ``router.failovers`` counts the
+      requests whose hash home was down.
+    * ``roles`` — per-replica :data:`ROLE_PREFILL`/:data:`ROLE_DECODE`.
+      This trace is DECODE traffic, so prefill replicas are folded out
+      of the candidate set exactly as a role-aware front door would
+      (they still occupy their global indices — placement indices stay
+      comparable with the fleet's).
+    """
     depths = [0] * router.n_replicas
+    role_ok = None
+    if roles is not None:
+        if len(roles) != router.n_replicas:
+            raise ValueError(
+                f"got {len(roles)} roles for {router.n_replicas} replicas")
+        bad = set(roles) - {ROLE_PREFILL, ROLE_DECODE}
+        if bad:
+            raise ValueError(f"unknown replica roles {sorted(bad)}; "
+                             f"choose from ('{ROLE_PREFILL}', "
+                             f"'{ROLE_DECODE}')")
+        role_ok = [r == ROLE_DECODE for r in roles]
+        if not any(role_ok):
+            raise NoLiveReplicasError(
+                "every replica is a prefill replica — decode traffic "
+                "has nowhere to land")
     out = []
     for i, p in enumerate(prompts):
-        r, _ = router.route(p, depths)
+        mask = live(i) if callable(live) else live
+        if mask is not None:
+            mask = [bool(x) for x in mask]
+        if role_ok is not None:
+            base = mask if mask is not None \
+                else [True] * router.n_replicas
+            mask = [a and b for a, b in zip(base, role_ok)]
+        r, _ = router.route(p, depths, live=mask)
         depths[r] += 1 if costs is None else int(costs[i])
         out.append(r)
     return out

@@ -52,6 +52,8 @@ KIND_SERVE_REPLICA_UP = "serve.replica_up"
 KIND_SERVE_FAILOVER = "serve.failover"
 KIND_SERVE_DRAIN = "serve.drain"
 KIND_SERVE_STATS = "serve.stats"
+KIND_SERVE_KV_TRANSFER = "serve.kv_transfer"
+KIND_SERVE_SPEC_ACCEPT = "serve.spec_accept"
 KIND_SHUTDOWN = "shutdown.graceful"
 KIND_ELASTIC_RESHARD = "elastic.reshard"
 

@@ -1,0 +1,503 @@
+"""Disaggregated-serving tests: int8 KV cache parity (ring and dense,
+including a window-512 layout), exact-greedy speculative decoding over
+ragged staggered admissions, prefill/decode hand-off token identity, and
+the role-aware routing/fleet layer.
+
+The exactness bar mirrors ``test_serving.py``: the serving-path variants
+must reproduce the plain scheduler's greedy tokens EXACTLY — int8 KV and
+speculative decoding are only admissible because they do."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.engine import (
+    InferenceEngine,
+    prefill_chunk_spans,
+)
+from deepspeed_tpu.inference.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.models.transformer_lm import GPT, GPTConfig
+from deepspeed_tpu.ops.quantizer import (
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+    apply_sparse_attention,
+    ring_engaged,
+    ring_storage_len,
+)
+from deepspeed_tpu.serving import (
+    DisaggServer,
+    FleetCoordinator,
+    PrefillWorker,
+    PrefixRouter,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    lane_kv_bytes,
+    route_trace,
+)
+from deepspeed_tpu.serving.router import NoLiveReplicasError
+from deepspeed_tpu.telemetry.bus import (
+    KIND_SERVE_KV_TRANSFER,
+    KIND_SERVE_SPEC_ACCEPT,
+    telemetry_bus,
+)
+
+# block 16, nswb 3 -> w_blk 1, ring = (1+1)*16 = 32 slots
+_WINDOW = {"mode": "local_sliding_window", "block": 16,
+           "num_sliding_window_blocks": 3}
+# block 128, nswb 7 -> w_blk 3, ring = (3+1)*128 = 512 slots
+_WINDOW_512 = {"mode": "local_sliding_window", "block": 128,
+               "num_sliding_window_blocks": 7}
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, n_positions=256, n_embd=32, n_layer=2,
+                n_head=4, dtype=jnp.float32, scan_layers=True,
+                rotary=True, learned_positions=False)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _ring_model(sparse=_WINDOW, **kw):
+    return apply_sparse_attention(GPT(_cfg(**kw)), sparse)
+
+
+def _prompts(seed=0, lens=(7, 23, 40, 70, 12)):
+    rng = np.random.default_rng(seed)
+    return [list(rng.integers(1, 128, size=n)) for n in lens]
+
+
+def _run(sched, prompts, max_new=8, **submit_kw):
+    for p in prompts:
+        sched.submit(p, max_new_tokens=max_new, **submit_kw)
+    stats = sched.run()
+    return stats, {c.request_id: c.tokens for c in stats.completions}
+
+
+class TestBlockwiseQuantizer:
+    def test_round_trip_error_bounded(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 64).astype(np.float32))
+        q, s = quantize_blockwise(x, 32)
+        assert q.dtype == jnp.int8
+        assert s.shape == (4, 2)
+        back = dequantize_blockwise(q, s, jnp.float32)
+        assert back.shape == x.shape
+        # symmetric int8: per-block relative error ~1/127 of the block max
+        err = np.abs(np.asarray(back - x))
+        bound = np.abs(np.asarray(x)).reshape(4, 2, 32).max(-1) / 127.0
+        assert (err.reshape(4, 2, 32) <= bound[..., None] + 1e-7).all()
+
+    def test_zeros_are_exact(self):
+        q, s = quantize_blockwise(jnp.zeros((2, 16)), 16)
+        assert np.asarray(dequantize_blockwise(q, s)).sum() == 0.0
+
+    def test_block_must_divide(self):
+        with pytest.raises(AssertionError):
+            quantize_blockwise(jnp.zeros((2, 10)), 16)
+
+
+class TestRingStorageSlack:
+    def test_slack_extends_storage_not_visibility(self):
+        cfg0 = _ring_model().config
+        cfg1 = _ring_model(kv_cache_slack_blocks=2).config
+        ring = ring_engaged(cfg0)
+        assert ring == ring_engaged(cfg1)  # the DECISION is unchanged
+        assert ring_storage_len(cfg0, ring) == 32
+        assert ring_storage_len(cfg1, ring) == 64
+
+    def test_slack_validation(self):
+        with pytest.raises(ValueError, match="kv_cache_slack_blocks"):
+            _cfg(kv_cache_slack_blocks=-1)
+
+    def test_kv_cache_dtype_validation(self):
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            _cfg(kv_cache_dtype="int4")
+        assert _cfg(kv_cache_dtype="int8").kv_cache_dtype == "int8"
+
+    def test_engine_kv_cache_config_key(self):
+        eng = InferenceEngine(GPT(_cfg()),
+                              {"dtype": "fp32", "kv_cache": "int8"},
+                              seed=0)
+        assert eng.module.config.kv_cache_dtype == "int8"
+        with pytest.raises(ValueError, match="kv_cache"):
+            InferenceEngine(GPT(_cfg()),
+                            {"dtype": "fp32", "kv_cache": "int4"}, seed=0)
+
+
+class TestSpecDecodeValidation:
+    def test_spec_k_needs_draft_and_vice_versa(self):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        with pytest.raises(ValueError, match="draft_engine"):
+            ContinuousBatchingScheduler(eng, prompt_bucket=16, spec_k=4)
+        draft = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=1)
+        with pytest.raises(ValueError, match="spec_k"):
+            ContinuousBatchingScheduler(eng, prompt_bucket=16,
+                                        draft_engine=draft)
+
+    def test_spec_requires_greedy(self):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        draft = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=1)
+        with pytest.raises(ValueError, match="temperature"):
+            ContinuousBatchingScheduler(eng, prompt_bucket=16,
+                                        temperature=0.7,
+                                        draft_engine=draft, spec_k=4)
+
+    def test_ring_target_needs_slack_block(self):
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        draft = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=1)
+        with pytest.raises(ValueError, match="slack"):
+            ContinuousBatchingScheduler(eng, draft_engine=draft, spec_k=4)
+
+    def test_spec_k_bounded_by_ring_block(self):
+        eng = InferenceEngine(_ring_model(kv_cache_slack_blocks=1),
+                              {"dtype": "fp32"}, seed=0)
+        draft = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=1)
+        with pytest.raises(ValueError, match="spec_k"):
+            ContinuousBatchingScheduler(eng, draft_engine=draft, spec_k=17)
+
+    def test_handoff_excludes_replay(self):
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(eng, prompt_bucket=16)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            sched.submit([1, 2, 3], max_new_tokens=8,
+                         replay_tokens=[4, 5], kv_handoff=(4, {}))
+
+
+class TestRouteTraceSimulator:
+    def test_roles_fold_prefill_replicas_out(self):
+        router = PrefixRouter(4)
+        prompts = _prompts(seed=3, lens=(8,) * 20)
+        placed = route_trace(router, prompts,
+                             roles=[ROLE_PREFILL, ROLE_DECODE,
+                                    ROLE_DECODE, ROLE_DECODE])
+        assert all(p != 0 for p in placed)
+
+    def test_all_prefill_raises(self):
+        with pytest.raises(NoLiveReplicasError):
+            route_trace(PrefixRouter(2), [[1, 2]],
+                        roles=[ROLE_PREFILL, ROLE_PREFILL])
+
+    def test_bad_role_raises(self):
+        with pytest.raises(ValueError, match="unknown replica roles"):
+            route_trace(PrefixRouter(2), [[1, 2]],
+                        roles=["decoder", ROLE_DECODE])
+
+    def test_scripted_outage_exercises_failover_branch(self):
+        router = PrefixRouter(3)
+        prompts = _prompts(seed=4, lens=(8,) * 12)
+        dead = router.home(prompts[0])
+
+        def live(step):
+            # replica `dead` is down for the first half of the trace
+            if step < 6:
+                return [i != dead for i in range(3)]
+            return None
+
+        placed = route_trace(router, [prompts[0]] * 12, live=live)
+        assert router.failovers == 6
+        assert all(p != dead for p in placed[:6])
+        # recovery: the home mapping is a pure hash, affinity returns
+        assert all(p == dead for p in placed[6:])
+
+    def test_fixed_mask(self):
+        router = PrefixRouter(2)
+        placed = route_trace(router, [[1]] * 4, live=[False, True])
+        assert placed == [1] * 4
+
+
+class TestFleetRoles:
+    def test_pools_and_transfer_accounting(self):
+        coord = FleetCoordinator(
+            PrefixRouter(4),
+            roles=[ROLE_PREFILL, ROLE_DECODE, ROLE_PREFILL, ROLE_DECODE])
+        pre, _ = coord.place_prefill([1, 2, 3])
+        dec, _ = coord.place("r0", [1, 2, 3], 8)
+        assert pre in (0, 2) and dec in (1, 3)
+        events = []
+        sub = telemetry_bus.subscribe(
+            lambda ev: events.append(ev)
+            if ev["kind"] == KIND_SERVE_KV_TRANSFER else None)
+        try:
+            coord.record_kv_transfer("r0", pre, dec, nbytes=4096,
+                                     transfer_s=0.01)
+        finally:
+            telemetry_bus.unsubscribe(sub)
+        assert coord.kv_transfers == 1 and coord.kv_bytes == 4096
+        assert events and events[0]["bytes"] == 4096
+        st = coord.stats()
+        assert st["roles"][0] == ROLE_PREFILL
+        assert st["kv_transfer"] == {"transfers": 1, "bytes": 4096}
+
+    def test_failover_lands_on_decode_pool(self):
+        coord = FleetCoordinator(
+            PrefixRouter(4),
+            roles=[ROLE_PREFILL, ROLE_DECODE, ROLE_PREFILL, ROLE_DECODE])
+        prompts = _prompts(seed=5, lens=(8,) * 6)
+        placed = [coord.place(i, p, 8)[0] for i, p in enumerate(prompts)]
+        assert all(r in (1, 3) for r in placed)
+        dead = placed[0]
+        survivor = 1 if dead == 3 else 3
+        moved = coord.replica_dead(dead)
+        assert moved and all(t == survivor for _, t, _s in moved)
+
+    def test_in_process_workers_survive_heartbeat_silence(self):
+        """In-process workers have no transport to heartbeat through —
+        DisaggServer must vouch for them, or the silence schedule marks
+        the whole prefill pool DOWN during the first prefill compile."""
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(eng, slots=2)
+        clock = {"t": 0.0}
+        coord = FleetCoordinator(
+            PrefixRouter(2), roles=[ROLE_PREFILL, ROLE_DECODE],
+            clock=lambda: clock["t"])
+        worker = PrefillWorker(eng, prompt_bucket=sched.prompt_bucket,
+                               replica=0)
+        server = DisaggServer(sched, [worker], coordinator=coord)
+        clock["t"] = 100.0  # far past down_after_s, zero heartbeats
+        assert server._pick_worker([1, 2, 3]) == 0
+
+    def test_needs_a_decode_replica(self):
+        with pytest.raises(ValueError, match="decode replica"):
+            FleetCoordinator(PrefixRouter(2),
+                             roles=[ROLE_PREFILL, ROLE_PREFILL])
+        coord = FleetCoordinator(PrefixRouter(2),
+                                 roles=[ROLE_DECODE, ROLE_DECODE])
+        with pytest.raises(ValueError, match="no prefill replicas"):
+            coord.place_prefill([1, 2])
+
+
+class TestLaneCapacity:
+    def test_int8_shrinks_resident_lane_bytes(self):
+        fp = lane_kv_bytes(_ring_model())
+        i8 = lane_kv_bytes(_ring_model(kv_cache_dtype="int8"))
+        assert i8["unquantized_bytes"] == fp["resident_bytes"]
+        # fp32 compute: int8 + f32/head scales ~= 3.5-3.9x smaller
+        ratio = fp["resident_bytes"] / i8["resident_bytes"]
+        assert ratio > 2.0, ratio
+
+    def test_slack_grows_ring_storage(self):
+        base = lane_kv_bytes(_ring_model())
+        slack = lane_kv_bytes(_ring_model(kv_cache_slack_blocks=1))
+        assert slack["resident_bytes"] > base["resident_bytes"]
+
+
+@pytest.mark.slow
+class TestInt8KVParity:
+    """int8 KV lanes must emit TOKEN-IDENTICAL greedy streams, and the
+    per-position logits must stay inside the blockwise-int8 error
+    envelope — across chunked prefill and decode, ring and dense."""
+
+    @pytest.mark.parametrize("sparse", [_WINDOW, _WINDOW_512],
+                             ids=["ring32", "window512"])
+    def test_every_position_logits_and_tokens(self, sparse):
+        blk = sparse["block"]
+        ring_len = (sparse["num_sliding_window_blocks"] // 2 + 1) * blk
+        n_pos = 4 * ring_len
+        T = 2 * ring_len + blk  # forces chunked prefill past the ring
+        kw = dict(n_positions=n_pos)
+        model = _ring_model(sparse, **kw)
+        model8 = _ring_model(sparse, kv_cache_dtype="int8", **kw)
+        rng = np.random.RandomState(3)
+        ids = jnp.asarray(rng.randint(0, 128, size=(2, T)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids,
+                            deterministic=True)["params"]
+
+        def chunked(m):
+            spans = prefill_chunk_spans(m.config, T)
+            assert spans is not None and len(spans) > 2
+
+            @jax.jit
+            def first(chunk):
+                return m.apply({"params": params}, chunk,
+                               deterministic=True, decode=True,
+                               mutable=["cache"])
+
+            @jax.jit
+            def more(cache, chunk):
+                return m.apply({"params": params, "cache": cache}, chunk,
+                               deterministic=True, decode=True,
+                               mutable=["cache"])
+
+            s0, e0 = spans[0]
+            logits, cache = first(ids[:, s0:e0])
+            pieces = [logits]
+            for s, e in spans[1:]:
+                logits, cache = more(cache["cache"], ids[:, s:e])
+                pieces.append(logits)
+            return jnp.concatenate(pieces, axis=1)
+
+        ref = np.asarray(chunked(model))
+        got = np.asarray(chunked(model8))
+        # logits inside the int8 error envelope at EVERY position (NOT
+        # the fp tolerance of the exact-parity tests — quantization
+        # error is real, bounded)
+        scale = np.abs(ref).max()
+        err = np.abs(ref - got).max()
+        assert err < 0.05 * scale
+        # argmax may flip only where the reference top-2 margin is
+        # itself inside that envelope (untrained params near-tie almost
+        # everywhere; trained-model margins are orders larger), and
+        # such positions must be rare
+        top2 = np.sort(ref, axis=-1)
+        margin = top2[..., -1] - top2[..., -2]
+        flips = ref.argmax(-1) != got.argmax(-1)
+        assert margin[flips].max(initial=0.0) < 2.0 * err
+        assert flips.mean() < 0.02, flips.mean()
+
+    def test_scheduler_tokens_identical_ring_and_dense(self):
+        prompts = _prompts()
+        for mk in (lambda **kw: _ring_model(**kw),
+                   lambda **kw: GPT(_cfg(**kw))):
+            eng = InferenceEngine(mk(), {"dtype": "fp32"}, seed=0)
+            _, base = _run(ContinuousBatchingScheduler(
+                eng, slots=3, prompt_bucket=16), prompts)
+            eng8 = InferenceEngine(
+                mk(), {"dtype": "fp32", "kv_cache": "int8"}, seed=0)
+            sched8 = ContinuousBatchingScheduler(eng8, slots=3,
+                                                 prompt_bucket=16)
+            _, got = _run(sched8, prompts)
+            assert got == base
+            kv = sched8.kv_cache_stats(hbm_override_gib=16.0)
+            assert kv["kv_cache_dtype"] == "int8"
+            assert kv["compression_ratio"] > 2.0
+            assert kv["lanes_at_hbm_budget"] > kv["lanes"]
+
+
+@pytest.mark.slow
+class TestSpeculativeDecoding:
+    """Accepted-token exactness: the spec-decoding stream must equal
+    sequential greedy over ragged staggered admissions — independent
+    draft (low acceptance) and self-draft (maximal acceptance) alike."""
+
+    def test_independent_draft_is_exact_ring(self):
+        prompts = _prompts()
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        _, base = _run(ContinuousBatchingScheduler(eng, slots=3), prompts)
+        engt = InferenceEngine(_ring_model(kv_cache_slack_blocks=1),
+                               {"dtype": "fp32"}, seed=0)
+        draft = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=7)
+        sched = ContinuousBatchingScheduler(engt, slots=3,
+                                            draft_engine=draft, spec_k=4)
+        events = []
+        sub = telemetry_bus.subscribe(
+            lambda ev: events.append(ev)
+            if ev["kind"] == KIND_SERVE_SPEC_ACCEPT else None)
+        try:
+            _, got = _run(sched, prompts)
+        finally:
+            telemetry_bus.unsubscribe(sub)
+        assert got == base
+        assert sched.spec_proposed > 0
+        assert events and events[0]["k"] == 4
+        assert sched.frontdoor_stats()["spec"]["proposed"] == \
+            sched.spec_proposed
+
+    def test_self_draft_accepts_maximally(self):
+        """Draft == target weights: every proposal matches, so each step
+        accepts m_eff = k-1 drafts + 1 verified token, and the step
+        count collapses by ~k (the spec-decode speedup, exactly)."""
+        prompts = _prompts()
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        st0, base = _run(ContinuousBatchingScheduler(eng, slots=3),
+                         prompts)
+        engt = InferenceEngine(_ring_model(kv_cache_slack_blocks=1),
+                               {"dtype": "fp32"}, seed=0)
+        draft = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(engt, slots=3,
+                                            draft_engine=draft, spec_k=4)
+        st, got = _run(sched, prompts)
+        assert got == base
+        # every live-lane proposal beyond the forced last column accepted
+        assert sched.spec_accepted == sched.spec_proposed * 3 // 4
+        assert st.decode_steps < st0.decode_steps
+
+    def test_dense_target_and_draft(self):
+        prompts = _prompts(seed=1)
+        eng = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        _, base = _run(ContinuousBatchingScheduler(
+            eng, slots=3, prompt_bucket=16), prompts)
+        engt = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=0)
+        draft = InferenceEngine(GPT(_cfg()), {"dtype": "fp32"}, seed=7)
+        sched = ContinuousBatchingScheduler(engt, slots=3,
+                                            prompt_bucket=16,
+                                            draft_engine=draft, spec_k=3)
+        _, got = _run(sched, prompts)
+        assert got == base
+
+    def test_eos_truncates_inside_accepted_run(self):
+        """EOS emitted mid-acceptance must stop that lane exactly where
+        sequential decode would, not flush the rest of the window."""
+        prompts = _prompts(seed=2, lens=(20, 40))
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        _, base = _run(ContinuousBatchingScheduler(eng, slots=2), prompts)
+        eos = base[0][2]
+
+        def trunc(seq):
+            return seq[:seq.index(eos) + 1] if eos in seq else seq
+
+        engt = InferenceEngine(_ring_model(kv_cache_slack_blocks=1),
+                               {"dtype": "fp32"}, seed=0)
+        draft = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(engt, slots=2,
+                                            draft_engine=draft, spec_k=4)
+        for p in prompts:
+            sched.submit(p, max_new_tokens=8, eos_token_id=eos)
+        _, got = {}, {c.request_id: c.tokens
+                      for c in sched.run().completions}
+        assert got[0] == trunc(base[0])
+        assert got[1] == trunc(base[1])
+
+    def test_int8_kv_composes_with_spec(self):
+        prompts = _prompts()
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        _, base = _run(ContinuousBatchingScheduler(eng, slots=3), prompts)
+        engt = InferenceEngine(_ring_model(kv_cache_slack_blocks=1),
+                               {"dtype": "fp32", "kv_cache": "int8"},
+                               seed=0)
+        draft = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(engt, slots=3,
+                                            draft_engine=draft, spec_k=4)
+        _, got = _run(sched, prompts)
+        assert got == base
+
+
+@pytest.mark.slow
+class TestDisaggHandoff:
+    def test_handoff_tokens_identical_and_metered(self):
+        prompts = _prompts()
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        _, base = _run(ContinuousBatchingScheduler(eng, slots=3), prompts)
+
+        eng2 = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(eng2, slots=3)
+        worker = PrefillWorker(eng2, prompt_bucket=sched.prompt_bucket)
+        server = DisaggServer(sched, [worker])
+        events = []
+        sub = telemetry_bus.subscribe(
+            lambda ev: events.append(ev)
+            if ev["kind"] == KIND_SERVE_KV_TRANSFER else None)
+        try:
+            for p in prompts:
+                server.submit(p, max_new_tokens=8)
+            stats = server.run()
+        finally:
+            telemetry_bus.unsubscribe(sub)
+        got = {c.request_id: c.tokens for c in stats.completions}
+        assert got == base
+        assert len(events) == len(prompts)
+        assert all(ev["bytes"] > 0 for ev in events)
+        st = server.stats()
+        assert st["handoffs"] == len(prompts)
+        assert st["workers"][0]["prefills"] == len(prompts)
+        assert "kv_cache" in st["frontdoor"]
+
+    def test_bucket_mismatch_rejected(self):
+        eng = InferenceEngine(_ring_model(), {"dtype": "fp32"}, seed=0)
+        sched = ContinuousBatchingScheduler(eng, slots=2,
+                                            prompt_bucket=16)
+        worker = PrefillWorker(eng, prompt_bucket=32)
+        with pytest.raises(ValueError, match="bucket"):
+            DisaggServer(sched, [worker])
