@@ -60,6 +60,12 @@
 #                deltas (benchmarks/communication/
 #                overlap_measured_results.json); nonzero exit when
 #                bucketed-on regresses beyond the measured noise band
+#   make hierarchical-exchange  ICI/DCN two-level exchange gate: per-
+#                level wire bytes (int8 DCN leg <= 0.3x flat bf16) and
+#                wall clock within the monolithic int8 baseline's
+#                3-sigma band (benchmarks/communication/
+#                hierarchical_exchange_results.json); nonzero exit past
+#                either bound
 #   make check   test + smoke-if-hot-paths-changed — the full gate
 #   make hooks   install the committed .githooks (pre-push runs
 #                `make quick` + conditional smoke)
@@ -75,7 +81,8 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
 .PHONY: quick test smoke chaos chaos-serve profile blackbox memreport \
         check hooks hot-changed serve-bench serve-bench-uniform \
         serve-bench-disagg data-bench \
-        dryrun mfu-search mfu-search-full overlap-measured
+        dryrun mfu-search mfu-search-full overlap-measured \
+        hierarchical-exchange
 
 # the <5-min smoke tier: config/mesh/kernels plus the comm + autotune +
 # process-group units, with tests marked `slow` (pyproject marker) opted
@@ -86,6 +93,7 @@ quick:
 	  tests/unit/test_ops.py tests/unit/test_comm.py \
 	  tests/unit/test_compressed_comm.py tests/unit/test_bucketed_comm.py \
 	  tests/unit/test_grad_exchange_modes.py \
+	  tests/unit/test_pipe_transport.py \
 	  tests/unit/test_flash_autotune.py tests/unit/test_procgroup.py \
 	  tests/unit/test_launcher.py tests/unit/test_serving.py \
 	  tests/unit/test_serving_frontdoor.py \
@@ -144,6 +152,9 @@ mfu-search-full:
 
 overlap-measured:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/communication/overlap_measured.py
+
+hierarchical-exchange:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/communication/hierarchical_exchange.py
 
 # the serving front-door headline: bursty prefix-skewed trace through
 # CB+prefix-cache vs cold CB vs sequential generate (docs/performance.md

@@ -2,6 +2,9 @@
 """Collective bandwidth sweeps (reference benchmarks/communication/*):
 all_reduce / all_gather / reduce_scatter / all_to_all / ppermute /
 broadcast over the mesh, reporting algbw and busbw per payload size.
+After the raw-verb sweep it also runs the two exchange-level benchmarks
+(``grad_exchange.py`` wire accounting and ``hierarchical_exchange.py``
+ICI/DCN split + regression gate); skip them with ``--sweep-only``.
 
 Run on real hardware (single chip: loopback numbers) or the virtual CPU
 mesh:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -37,12 +40,21 @@ def main():
     p.add_argument("--min-bytes", type=int, default=1 << 16)
     p.add_argument("--max-bytes", type=int, default=1 << 26)
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--sweep-only", action="store_true",
+                   help="raw collective sweep only; skip the "
+                        "grad_exchange / hierarchical_exchange benchmarks")
     args = p.parse_args()
 
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
     import jax
 
     if args.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu  # noqa: F401  (installs the jax.shard_map shim)
     import jax.numpy as jnp
     import numpy as np
     from jax import shard_map
@@ -110,6 +122,22 @@ def main():
             size *= 4
     print("# done")
 
+    if args.sweep_only:
+        return 0
+    # exchange-level benchmarks ride along so one invocation refreshes
+    # every committed communication artifact; their nonzero exits (the
+    # hierarchical 3-sigma regression gate) propagate
+    import grad_exchange
+    import hierarchical_exchange
+
+    print("\n# grad_exchange")
+    rc = grad_exchange.main([])
+    print("\n# hierarchical_exchange")
+    rc = hierarchical_exchange.main([]) or rc
+    return rc
+
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

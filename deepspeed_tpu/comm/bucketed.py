@@ -32,6 +32,12 @@ over a named mesh axis):
   worker/server error-feedback residuals carried PER BUCKET on the flat
   concatenated payload and per-bucket wire accounting
   (``<log_name>.bucket<i>`` payload + ``.scales`` sideband).
+- :func:`hierarchical_all_reduce` — the two-level ICI/DCN exchange
+  ("Scale MLPerf-0.6 models on Google TPU-v3 Pods" posture with the
+  EQuARX inter-slice wire, PAPERS.md): bf16 reduce-scatter within each
+  slice over ICI, int8 quantized exchange of the 1/P reduced shard
+  across slices over DCN, bf16 all-gather back within the slice. DCN
+  moves ~2(G-1)/G x N/P int8 bytes instead of 2(W-1)/W x 2N bf16 bytes.
 """
 
 import dataclasses
@@ -156,6 +162,106 @@ def bucketed_all_reduce(tree: Any, axis: str,
         if mean:
             reduced = reduced / w
         _split_bucket(reduced, leaves, idxs, out)
+    return jax.tree.unflatten(treedef, out)
+
+
+def hierarchy_groups(world: int, num_slices: int
+                     ) -> Tuple[Tuple[Tuple[int, ...], ...],
+                                Tuple[Tuple[int, ...], ...]]:
+    """ICI/DCN ``axis_index_groups`` for a dp axis of ``world`` ranks laid
+    out over ``num_slices`` slices.
+
+    Assumes the slice dimension is the SLOW (outer) dimension of the axis:
+    rank = slice_idx * per_slice + ici_idx. That is exactly what
+    ``mesh_utils.create_hybrid_device_mesh`` produces (the dcn mesh shape
+    stacks outside each per-slice mesh — ``parallel/mesh.py:_arrange``),
+    and what ``tpu.grad_exchange.dcn_slices`` emulates on the virtual CPU
+    mesh. ICI groups are the contiguous per-slice runs; DCN groups take
+    one rank at the same ICI position from every slice.
+    """
+    if num_slices < 1 or world % num_slices:
+        raise ValueError(
+            f"cannot split a dp axis of {world} ranks into {num_slices} "
+            f"equal slices")
+    per = world // num_slices
+    ici = tuple(tuple(s * per + i for i in range(per))
+                for s in range(num_slices))
+    dcn = tuple(tuple(s * per + i for s in range(num_slices))
+                for i in range(per))
+    return ici, dcn
+
+
+def hierarchical_all_reduce(tree: Any, axis: str, num_slices: int,
+                            plan: Optional[BucketPlan] = None, *,
+                            block: int = 512, wire_dtype=jnp.bfloat16,
+                            mean: bool = False,
+                            log_name: str = "hierarchical_grad_exchange"
+                            ) -> Any:
+    """Two-level ICI/DCN sum (or mean) all-reduce of a gradient tree.
+
+    Per bucket, with W ranks in ``num_slices`` slices of P ranks each:
+
+    1. ``psum_scatter`` the bucket within each slice (ICI, ``wire_dtype``
+       — bf16 by default): every rank ends with its slice's sum of a
+       1/P shard.
+    2. :func:`quantized_all_reduce` of the shard ACROSS slices (DCN,
+       int8 + per-block fp32 scales) via ``axis_index_groups`` — the
+       EQuARX wire format on the expensive interconnect, at 1/P of the
+       tensor. No error feedback: the deferred exchange is stateless
+       (one exchange per optimizer step; residuals would need optimizer
+       state the bf16/fp32 deferred family deliberately does not carry).
+    3. ``all_gather`` the globally reduced shard back within each slice
+       (ICI, ``wire_dtype``).
+
+    Wire accounting tags the intra-slice legs ``level="ici"`` and the
+    inter-slice leg ``level="dcn"`` (``Comm/ici_bytes`` /
+    ``Comm/dcn_bytes``). ``num_slices=1`` degenerates to a single-level
+    scatter/gather psum (no DCN leg, everything metered as ICI).
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if plan is None:
+        plan = assign_buckets([l.size for l in leaves], 0)
+    w = int(lax.psum(1, axis))
+    ici_groups, dcn_groups = hierarchy_groups(w, num_slices)
+    per_slice = w // num_slices
+    out = [None] * len(leaves)
+    for b, idxs in enumerate(plan.bucket_leaves):
+        flat = _concat_bucket(leaves, idxs)
+        n = flat.size
+        pad = (-n) % per_slice
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        payload = (flat if wire_dtype is None
+                   or flat.dtype == jnp.dtype(wire_dtype)
+                   else flat.astype(wire_dtype))
+        comms_logger.append("reduce_scatter", payload, axis,
+                            log_name=f"{log_name}.bucket{b}.ici",
+                            world=per_slice, level="ici")
+        shard = lax.psum_scatter(
+            payload, axis, scatter_dimension=0, tiled=True,
+            axis_index_groups=list(map(list, ici_groups))
+        ).astype(flat.dtype)
+        if num_slices > 1:
+            shard = quantized_all_reduce(
+                shard, axis, block=block,
+                axis_index_groups=list(map(list, dcn_groups)),
+                log_name=f"{log_name}.bucket{b}.dcn", level="dcn")
+        gathered = (shard if wire_dtype is None
+                    or shard.dtype == jnp.dtype(wire_dtype)
+                    else shard.astype(wire_dtype))
+        comms_logger.append("all_gather", gathered, axis,
+                            log_name=f"{log_name}.bucket{b}.ici",
+                            world=per_slice, level="ici")
+        full = lax.all_gather(
+            gathered, axis, tiled=True,
+            axis_index_groups=list(map(list, ici_groups))
+        ).astype(flat.dtype)
+        if pad:
+            full = full[:n]
+        if mean:
+            full = full / w
+        _split_bucket(full, leaves, idxs, out)
     return jax.tree.unflatten(treedef, out)
 
 

@@ -243,6 +243,18 @@ def init_distributed(
             "inter-stage transfers across hosts will be unavailable — "
             "set DS_TPU_TRANSFER_ADDR=<this_host_ip>:0 to enable them")
 
+    # the CPU backend compiles cross-process programs only when a CPU
+    # collectives implementation is configured (gloo); without it every
+    # multi-process jit — including the virtual-mesh tests — aborts with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Must be set BEFORE backend init; harmless for TPU/GPU platforms.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:  # old jax without the option, or no gloo build
+        logger.warning(
+            f"could not enable gloo CPU collectives ({e}); multi-process "
+            "runs on the CPU backend will not work")
+
     # log_dist is unusable before the rendezvous: it queries
     # jax.process_index(), which initialises the XLA backend and makes
     # jax.distributed.initialize fail — use the raw logger here so a

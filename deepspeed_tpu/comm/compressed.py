@@ -48,7 +48,9 @@ def server_shard_length(n: int, w: int, block: int = 512) -> int:
 def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
                          return_error: bool = False,
                          server_error: jnp.ndarray = None,
-                         log_name: str = "quantized_all_reduce"
+                         log_name: str = "quantized_all_reduce",
+                         axis_index_groups=None,
+                         level=None
                          ) -> Union[jnp.ndarray,
                                     Tuple[jnp.ndarray, jnp.ndarray],
                                     Tuple[jnp.ndarray, jnp.ndarray,
@@ -76,8 +78,21 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     scale sideband under ``<log_name>.scales``) so callers issuing many
     exchanges — e.g. the bucketed reducer in ``comm/bucketed.py`` — can
     meter each one separately.
+
+    ``axis_index_groups`` restricts the reduction to disjoint equal-size
+    subgroups of the axis (jax semantics: each rank reduces with its own
+    group only) — the hierarchical exchange uses this for the inter-slice
+    DCN leg without adding a mesh axis. ``level`` ("ici"/"dcn") tags the
+    wire accounting with the interconnect this exchange crosses.
     """
-    w = int(lax.psum(1, axis))  # static axis size at trace time
+    if axis_index_groups is not None:
+        sizes = {len(g) for g in axis_index_groups}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"axis_index_groups must be equal-size, got sizes {sizes}")
+        w = sizes.pop()
+    else:
+        w = int(lax.psum(1, axis))  # static axis size at trace time
     shape, dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).ravel()
     n = flat.size
@@ -94,13 +109,15 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     # tensor never does) — log both under distinct names so the comm
     # benchmarks can report payload vs sideband
     comms_logger.append("all_to_all", q, axis,
-                        log_name=log_name, world=w)
+                        log_name=log_name, world=w, level=level)
     comms_logger.append("all_to_all", s, axis,
-                        log_name=f"{log_name}.scales", world=w)
+                        log_name=f"{log_name}.scales", world=w, level=level)
     q_recv = lax.all_to_all(q.reshape(w, per), axis,
-                            split_axis=0, concat_axis=0, tiled=False)
+                            split_axis=0, concat_axis=0, tiled=False,
+                            axis_index_groups=axis_index_groups)
     s_recv = lax.all_to_all(s.reshape(w, per // block), axis,
-                            split_axis=0, concat_axis=0, tiled=False)
+                            split_axis=0, concat_axis=0, tiled=False,
+                            axis_index_groups=axis_index_groups)
     # q_recv: [W, per] — W ranks' int8 copies of MY shard; dequant + sum
     contribs = (q_recv.reshape(w, per // block, block).astype(jnp.float32)
                 * s_recv[..., None])
@@ -111,11 +128,13 @@ def quantized_all_reduce(x: jnp.ndarray, axis: str, block: int = 512,
     # phase 2: re-quantize the reduced shard, all_gather, dequantize
     q2, s2 = _quantize_blocks(reduced, block)
     comms_logger.append("all_gather", q2, axis,
-                        log_name=log_name, world=w)
+                        log_name=log_name, world=w, level=level)
     comms_logger.append("all_gather", s2, axis,
-                        log_name=f"{log_name}.scales", world=w)
-    q_all = lax.all_gather(q2, axis, tiled=True)      # [W * per]
-    s_all = lax.all_gather(s2, axis, tiled=True)      # [W * per/block]
+                        log_name=f"{log_name}.scales", world=w, level=level)
+    q_all = lax.all_gather(q2, axis, tiled=True,
+                           axis_index_groups=axis_index_groups)
+    s_all = lax.all_gather(s2, axis, tiled=True,
+                           axis_index_groups=axis_index_groups)
     out = dequantize(q_all, s_all)
     if pad:
         out = out[:n]

@@ -77,6 +77,11 @@ class CommsLogger:
             lambda: {"count": 0, "bytes": 0, "wire_bytes": 0,
                      "wire_dtype": None, "msg_sizes": defaultdict(int)}
         )
+        # interconnect-level rollup: wire bytes tagged "ici" (intra-slice
+        # fabric) vs "dcn" (inter-slice network) by the hierarchical
+        # exchange (comm/bucketed.py hierarchical_all_reduce). Untagged
+        # records (the flat single-level exchanges) land in neither.
+        self.level_bytes: Dict[str, int] = defaultdict(int)
 
     def configure(self, config) -> None:
         self.enabled = config.enabled
@@ -92,7 +97,8 @@ class CommsLogger:
 
     def append(self, op_name: str, tensor, axis: Optional[str],
                log_name: Optional[str] = None, wire_dtype=None,
-               world: Optional[int] = None) -> None:
+               world: Optional[int] = None,
+               level: Optional[str] = None) -> None:
         """Record one collective at trace time.
 
         ``bytes`` counts the logical input payload in the tensor's own
@@ -100,7 +106,9 @@ class CommsLogger:
         on-the-wire estimate: the payload re-expressed in ``wire_dtype``
         (what actually crosses the interconnect — int8 for the quantized
         path) scaled by :func:`wire_factor` for the op's ring cost at axis
-        size ``world``.
+        size ``world``. ``level`` ("ici" | "dcn") additionally rolls the
+        wire bytes into the per-interconnect counters the hierarchical
+        exchange exports (``Comm/ici_bytes`` / ``Comm/dcn_bytes``).
         """
         name = log_name or op_name
         if not self._should_log(name):
@@ -122,6 +130,8 @@ class CommsLogger:
             if wire_dtype is not None:
                 rec["wire_dtype"] = str(np.dtype(wire_dtype))
             rec["msg_sizes"][size] += 1
+            if level is not None:
+                self.level_bytes[str(level)] += wire
         if self.verbose:
             log_dist(
                 f"comm op: {name} | axis: {axis} | msg size: {size} bytes"
@@ -143,6 +153,11 @@ class CommsLogger:
                 out[f"{key}_bytes"] = float(rec["bytes"])
                 out[f"{key}_wire_bytes"] = float(rec["wire_bytes"])
                 total_wire += rec["wire_bytes"]
+            # per-interconnect rollups (docs/observability.md): always
+            # exported so dashboards can alert on dcn_bytes == 0 when a
+            # hierarchical config silently fell back to the flat path
+            out["ici_bytes"] = float(self.level_bytes.get("ici", 0))
+            out["dcn_bytes"] = float(self.level_bytes.get("dcn", 0))
         out["total_wire_bytes"] = float(total_wire)
         return out
 
@@ -167,6 +182,7 @@ class CommsLogger:
     def reset(self) -> None:
         with self._lock:
             self.comms_dict.clear()
+            self.level_bytes.clear()
 
 
 # process-global instance, configured by the engine from the comms_logger block

@@ -77,6 +77,18 @@ class MeshTopology:
 
         self.axis_sizes = sizes
         shape = tuple(sizes[a] for a in AXIS_ORDER)
+        # slice structure (multi-slice TPU pods): how many DCN-connected
+        # slices the devices span and how the slice count factors into the
+        # outer mesh axes. On a single slice / CPU backend every factor is
+        # 1 — consumers (the hierarchical gradient exchange) read
+        # dcn_size("dp") and fall back to the flat exchange at 1.
+        is_tpu = bool(devices) and getattr(
+            devices[0], "platform", "cpu") == "tpu"
+        self.num_slices = (len({getattr(d, "slice_index", None) or 0
+                                for d in devices}) if is_tpu else 1)
+        self.dcn_shape = (self._derive_dcn_shape(shape, self.num_slices)
+                          if self.num_slices > 1
+                          else tuple(1 for _ in shape))
         device_array = self._arrange(devices, shape)
         self.mesh = Mesh(device_array, AXIS_ORDER)
 
@@ -149,6 +161,16 @@ class MeshTopology:
     # -- size queries (parity: groups.get_data_parallel_world_size etc.) ---
     def size(self, axis: str) -> int:
         return self.axis_sizes[axis]
+
+    def dcn_size(self, axis: str) -> int:
+        """How many DCN-connected slice groups the axis spans (1 on a
+        single slice): the factor of ``num_slices`` that
+        :meth:`_derive_dcn_shape` assigned to this axis. An axis with
+        ``dcn_size > 1`` has its slice dimension as the SLOW (outer)
+        dimension — rank = slice_idx * per_slice + ici_idx (the
+        ``create_hybrid_device_mesh`` layout ``comm.bucketed.
+        hierarchy_groups`` assumes)."""
+        return self.dcn_shape[AXIS_ORDER.index(axis)]
 
     @property
     def num_devices(self) -> int:

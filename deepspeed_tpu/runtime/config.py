@@ -482,6 +482,18 @@ class GradExchangeConfig(ConfigModel):
     bucket_mb: float = 0.0
     deferred: bool = False
     wire_dtype: str = "bf16"  # bf16 | fp32 (deferred exchange payload)
+    # two-level ICI/DCN exchange (comm/bucketed.py hierarchical_all_reduce):
+    # intra-slice wire_dtype psum over ICI, inter-slice bucketed int8
+    # EQuARX exchange over DCN. "auto" activates when the mesh detects a
+    # multi-slice dp axis (MeshTopology.dcn_size("dp") > 1) and falls back
+    # to the flat exchange otherwise; "on" demands slice structure (loud
+    # failure without it). Requires deferred=true.
+    hierarchical: str = "off"  # off | auto | on
+    # >0 forces the inter-slice group count (slice-major over the dp axis)
+    # instead of detecting it from device.slice_index — how the virtual
+    # CPU mesh exercises the DCN leg; 0 = detect
+    dcn_slices: int = 0
+    dcn_block: int = 512  # int8 quantization block for the DCN leg
 
     def __post_init__(self):
         if self.wire_dtype not in ("bf16", "bfloat16", "fp32", "float32"):
@@ -492,6 +504,44 @@ class GradExchangeConfig(ConfigModel):
             raise DeepSpeedConfigError(
                 f"tpu.grad_exchange.bucket_mb must be >= 0, got "
                 f"{self.bucket_mb}")
+        if self.hierarchical not in ("off", "auto", "on"):
+            raise DeepSpeedConfigError(
+                "tpu.grad_exchange.hierarchical must be one of off/auto/on,"
+                f" got {self.hierarchical!r}")
+        if self.dcn_slices < 0:
+            raise DeepSpeedConfigError(
+                f"tpu.grad_exchange.dcn_slices must be >= 0, got "
+                f"{self.dcn_slices}")
+        if self.dcn_block < 1:
+            raise DeepSpeedConfigError(
+                f"tpu.grad_exchange.dcn_block must be >= 1, got "
+                f"{self.dcn_block}")
+
+
+@dataclass
+class TpuPipelineConfig(ConfigModel):
+    """Pipeline stage-to-stage transport (``runtime/pipe/transport.py``).
+
+    ``transport`` picks how activations/cotangents hop between stage
+    sub-meshes:
+
+    - ``device_put`` — host-level cross-mesh transfer (the original
+      single-process fast path; on a multi-process CPU mesh this path
+      cannot be emulated and hangs — see tests/unit/test_multihost.py).
+    - ``ppermute`` — one jitted ``lax.ppermute`` over the JOINT (pp, dp)
+      mesh: works across process boundaries and lets XLA overlap the
+      transfer with compute.
+    - ``auto`` — ppermute when ``jax.process_count() > 1``, device_put
+      otherwise. The transport never leaks into checkpoint layout.
+    """
+
+    transport: str = "auto"  # auto | ppermute | device_put
+
+    def __post_init__(self):
+        if self.transport not in ("auto", "ppermute", "device_put"):
+            raise DeepSpeedConfigError(
+                "tpu.pipeline.transport must be one of auto/ppermute/"
+                f"device_put, got {self.transport!r}")
 
 
 @dataclass
@@ -557,10 +607,16 @@ class TpuConfig(ConfigModel):
     grad_exchange: Dict[str, Any] = field(default_factory=dict)
     # HBM-bounded step-config autotuner — see StepAutotuneConfig
     step_autotune: Dict[str, Any] = field(default_factory=dict)
+    # pipeline stage-to-stage transport — see TpuPipelineConfig
+    pipeline: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def mesh_config(self) -> MeshConfig:
         return MeshConfig.from_dict(self.mesh)
+
+    @property
+    def pipeline_config(self) -> "TpuPipelineConfig":
+        return TpuPipelineConfig.from_dict(self.pipeline)
 
     @property
     def grad_exchange_config(self) -> GradExchangeConfig:

@@ -304,6 +304,7 @@ class DeepSpeedEngine:
         self._comp_k = None
         self._bucket_plan = None  # comm/bucketed.py plan, set at state init
         self._gx_wire_dtype = jnp.bfloat16
+        self._gx_num_slices = 1  # >1 = two-level ICI/DCN exchange
         if optimizer is None and is_compressed_optimizer(config.optimizer.type):
             self._compressed_mode = "onebit"
         elif config.communication_data_type == "int8":
@@ -317,6 +318,14 @@ class DeepSpeedEngine:
             self._compressed_mode = "deferred"
         if self._compressed_mode is not None:
             self._validate_compressed_config(config, topology)
+        elif config.tpu.grad_exchange_config.hierarchical == "on":
+            # "on" demands the two-level exchange; with no deferred
+            # exchange engaged that is a config contradiction, not a
+            # fallback case ("auto" is the degrade-quietly spelling)
+            raise ValueError(
+                "tpu.grad_exchange.hierarchical: on requires the deferred "
+                "exchange (tpu.grad_exchange.deferred: true on a dp>1 "
+                "mesh)")
         # whether the compressed step materializes a real averaged-grad norm
         # (int8/deferred: free from the post-exchange mean; onebit:
         # debug-gated)
@@ -613,6 +622,14 @@ class DeepSpeedEngine:
             raise ValueError(
                 f"{mode} compressed gradient exchange cannot combine with "
                 "offload_optimizer (the host step bypasses the exchange)")
+        if (config.tpu.grad_exchange_config.hierarchical != "off"
+                and mode != "deferred"):
+            raise ValueError(
+                "tpu.grad_exchange.hierarchical requires the deferred "
+                "bf16/fp32 exchange (grad_exchange.deferred: true); the "
+                "onebit/int8 paths own their wire format end to end and "
+                "carry error-feedback state the two-level exchange does "
+                "not")
         if config.gradient_clipping and mode == "onebit":
             logger.warning(
                 "gradient_clipping is ignored with the 1-bit optimizers: "
@@ -840,6 +857,30 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # compressed gradient exchange (1-bit optimizers / int8 grad comm)
     # ------------------------------------------------------------------
+    def _resolve_dcn_slices(self, gx):
+        """Inter-slice group count for the hierarchical deferred exchange
+        (1 = flat single-level). ``dcn_slices`` overrides detection so the
+        virtual CPU mesh can exercise the DCN leg; otherwise the slice
+        factor the mesh derived for the dp axis
+        (``MeshTopology.dcn_size``) is used."""
+        if gx.hierarchical == "off":
+            return 1
+        w = self.topology.size("dp")
+        n = gx.dcn_slices or self.topology.dcn_size("dp")
+        if n <= 1:
+            if gx.hierarchical == "on":
+                raise ValueError(
+                    "tpu.grad_exchange.hierarchical: on, but the dp axis "
+                    "has no slice structure (single-slice mesh and "
+                    "dcn_slices unset) — use hierarchical: auto to fall "
+                    "back to the flat exchange, or set dcn_slices")
+            return 1
+        if w % n:
+            raise ValueError(
+                f"hierarchical exchange: {n} DCN slices do not divide the "
+                f"dp axis of {w} ranks")
+        return n
+
     def _init_compressed_state(self, param_shapes):
         """State for the shard_mapped compressed step.
 
@@ -874,6 +915,23 @@ class DeepSpeedEngine:
         self._gx_wire_dtype = (jnp.float32
                                if gx.wire_dtype in ("fp32", "float32")
                                else jnp.bfloat16)
+        self._gx_num_slices = (self._resolve_dcn_slices(gx)
+                               if self._compressed_mode == "deferred" else 1)
+        if self._gx_num_slices > 1:
+            # discrete layout decision -> telemetry (docs/observability.md):
+            # the flight recorder sees which ranks pay DCN and in what wire
+            from deepspeed_tpu.telemetry.bus import (KIND_COMM_HIERARCHY,
+                                                     publish)
+
+            publish(KIND_COMM_HIERARCHY,
+                    world=int(self._comp_k),
+                    num_slices=int(self._gx_num_slices),
+                    per_slice=int(self._comp_k // self._gx_num_slices),
+                    ici_wire=str(jnp.dtype(self._gx_wire_dtype)),
+                    dcn_wire="int8",
+                    dcn_block=int(gx.dcn_block),
+                    num_buckets=int(self._bucket_plan.num_buckets
+                                    if self._bucket_plan else 0))
 
         if self._compressed_mode == "onebit":
             st_shape = jax.eval_shape(self._tx.init, param_shapes)
@@ -967,6 +1025,8 @@ class DeepSpeedEngine:
         mode = self._compressed_mode
         plan = self._bucket_plan
         wire = self._gx_wire_dtype
+        num_slices = self._gx_num_slices
+        dcn_block = self._config.tpu.grad_exchange_config.dcn_block
 
         clip = self.gradient_clipping
         debug_norm = self._config.tpu.compressed_grad_norm
@@ -998,15 +1058,26 @@ class DeepSpeedEngine:
                     server_error=jax.tree.map(
                         lambda x: x[None], new_st.server_error))
             elif mode == "deferred":
-                from deepspeed_tpu.comm.bucketed import bucketed_all_reduce
+                from deepspeed_tpu.comm.bucketed import (
+                    bucketed_all_reduce, hierarchical_all_reduce)
 
                 (inner,) = opt_state
-                # ONE bucketed explicit exchange at the GAS boundary: each
-                # bucket is an independent collective XLA may overlap with
-                # the others' cast/unpack compute (T3-style)
-                mean_g = bucketed_all_reduce(
-                    local_g, "dp", plan, wire_dtype=wire, mean=True,
-                    log_name="bucketed_grad_exchange")
+                if num_slices > 1:
+                    # two-level ICI/DCN exchange: wire_dtype psum_scatter /
+                    # all_gather inside each slice, bucketed int8 EQuARX
+                    # exchange of the 1/P shard across slices
+                    mean_g = hierarchical_all_reduce(
+                        local_g, "dp", num_slices, plan,
+                        block=dcn_block, wire_dtype=wire, mean=True,
+                        log_name="hierarchical_grad_exchange")
+                else:
+                    # ONE bucketed explicit exchange at the GAS boundary:
+                    # each bucket is an independent collective XLA may
+                    # overlap with the others' cast/unpack compute
+                    # (T3-style)
+                    mean_g = bucketed_all_reduce(
+                        local_g, "dp", plan, wire_dtype=wire, mean=True,
+                        log_name="bucketed_grad_exchange")
                 new_opt_tail = ()
             elif plan is not None:
                 from deepspeed_tpu.comm.bucketed import (

@@ -10,11 +10,15 @@ TPU re-design (SURVEY.md §7 hard part (a)):
 * Each stage owns a **sub-mesh**: the slice of the global mesh at its ``pp``
   coordinate, with the remaining axes (dp/fsdp/tp/...) intact — ZeRO and TP
   compose per stage via the same ZeroShardingRules as the dense engine.
-* The host is the single controller. It walks the 1F1B clock stream
-  (pipe/schedule.py) and dispatches per-stage **jitted programs**; JAX async
-  dispatch overlaps stages on their devices, and activation transfer is a
-  ``jax.device_put`` onto the next stage's sub-mesh (ICI), replacing
-  torch.distributed send/recv + meta exchange (reference pipe/p2p.py:48-161).
+* The host walks the 1F1B clock stream (pipe/schedule.py) and dispatches
+  per-stage **jitted programs**; JAX async dispatch overlaps stages on
+  their devices. Activation transfer goes through pipe/transport.py
+  (``tpu.pipeline.transport``): a cross-mesh ``jax.device_put`` in a
+  single process, or an in-program ``lax.ppermute`` over the joint
+  ``(pp, dp, ...)`` mesh — the mode that makes multi-process pipeline
+  parallelism work (replacing torch.distributed send/recv + meta
+  exchange, reference pipe/p2p.py:48-161). Multi-process runs gate each
+  stage's compute on ownership of its sub-mesh.
 * Stage backward is **recompute-based** (jax.vjp inside one jitted program):
   only the stage *input* is stored per in-flight micro batch — the 1F1B
   activation footprint without hook machinery.
@@ -47,6 +51,10 @@ from deepspeed_tpu.runtime.lr_schedules import (
 from deepspeed_tpu.runtime.optimizer import build_optimizer
 from deepspeed_tpu.runtime.pipe.module import PipelineModule, TiedLayerSpec
 from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+from deepspeed_tpu.runtime.pipe.transport import (
+    StageTransport,
+    resolve_transport,
+)
 from deepspeed_tpu.runtime.zero.sharding import ZeroShardingRules
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import ThroughputTimer
@@ -147,6 +155,26 @@ class PipelineEngine:
                     self.tied_groups.setdefault(spec.key, []).append(
                         (s, f"layer_{bounds[s] + i}"))
 
+        # ---- stage-to-stage transport ------------------------------------
+        # tpu.pipeline.transport: auto|ppermute|device_put (see
+        # pipe/transport.py for the trade-off)
+        self.transport_mode = resolve_transport(
+            config.tpu.pipeline_config.transport)
+        self.transport = StageTransport(
+            topology, self.stage_topos, self.transport_mode)
+        self._multiprocess = jax.process_count() > 1
+        if self._multiprocess and self.transport_mode == "device_put":
+            logger.warning(
+                "pipeline transport=device_put on a multi-process mesh: "
+                "cross-host device_put needs the backend's transfer server "
+                "and hangs on backends without one — prefer "
+                "tpu.pipeline.transport: ppermute")
+        if self._multiprocess and self.tied_groups:
+            raise NotImplementedError(
+                "tied pipeline layers across processes are not supported "
+                "yet: tied-weight sync is host-driven (device_get/put) and "
+                "cannot reach non-addressable stages")
+
         # ---- optimizer / schedule ----------------------------------------
         self.lr_scheduler, self._schedule_fn = self._configure_lr(lr_scheduler)
         if optimizer is not None and isinstance(
@@ -224,7 +252,14 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     # lazy init: build per-stage params/opt-state on their sub-meshes
     # ------------------------------------------------------------------
-    def _init_state(self, first_inputs):
+    def _init_state(self, first_input_avals):
+        """Materialize per-stage params/opt state from the FIRST input's
+        avals. The whole chain is aval-driven: every process walks it
+        host-side (eval_shape), and each stage's state is materialized
+        only by its owners (a jit over a fully non-addressable sub-mesh
+        is illegal in multi-controller JAX). Flax init depends only on
+        rng + shapes, so seeding the chain with zeros keeps parameters
+        identical across transports and process layouts."""
         self._params: List[Any] = []
         self._opt_states: List[Any] = []
         self._param_shardings: List[Any] = []
@@ -235,8 +270,20 @@ class PipelineEngine:
         self._bwd_fns: List[Any] = [None] * self.num_stages
         self._apply_fns: List[Any] = [None] * self.num_stages
         self._apply_fns_nodonate: List[Any] = [None] * self.num_stages
+        # per-stage input/output avals: the transport needs them on EVERY
+        # process (receivers assemble buffers before any data arrives)
+        self._stage_in_avals: List[Any] = []
+        self._stage_out_avals: List[Any] = []
+        # param SHAPES (host-side avals) are kept on every process: they
+        # let eval_batch re-derive activation avals for arbitrary batch
+        # shapes without owning the stage's params
+        self._stage_param_shapes: List[Any] = []
 
-        x = first_inputs
+        x_aval = jax.tree.map(
+            lambda v: jax.ShapeDtypeStruct(
+                tuple(v.shape), jnp.asarray(v).dtype
+                if not hasattr(v, "dtype") else v.dtype),
+            first_input_avals)
         rng = self._rng
         for s in range(self.num_stages):
             topo = self.stage_topos[s]
@@ -244,39 +291,53 @@ class PipelineEngine:
             rules = ZeroShardingRules(topo, stage=self.zero_stage,
                                       tp_rules=self.module.tp_rules)
             self._rules.append(rules)
+            self._stage_in_avals.append(x_aval)
             rng_s = jax.random.fold_in(rng, s)
 
             def init_fn(r, xv):
                 return mod.init({"params": r}, xv, deterministic=True)["params"]
 
-            shapes = jax.eval_shape(init_fn, rng_s, x)
-            p_shard = rules.param_sharding_tree(shapes)
-            params = jax.jit(init_fn, out_shardings=p_shard)(rng_s, x)
-            opt_shapes = jax.eval_shape(self._tx.init, shapes)
-            o_shard = rules.opt_sharding_tree(opt_shapes, shapes)
-            opt_state = jax.jit(self._tx.init, out_shardings=o_shard)(params)
+            shapes = jax.eval_shape(init_fn, rng_s, x_aval)
+            self._stage_param_shapes.append(shapes)
+            if self.transport.owns_stage(s):
+                p_shard = rules.param_sharding_tree(shapes)
+                xz = self._zeros_on_stage(x_aval, s)
+                params = jax.jit(init_fn, out_shardings=p_shard)(rng_s, xz)
+                opt_shapes = jax.eval_shape(self._tx.init, shapes)
+                o_shard = rules.opt_sharding_tree(opt_shapes, shapes)
+                opt_state = jax.jit(
+                    self._tx.init, out_shardings=o_shard)(params)
+                acc = jax.tree.map(
+                    lambda v: jnp.zeros(v.shape, jnp.float32), params)
+            else:
+                params = opt_state = acc = p_shard = o_shard = None
             self._params.append(params)
             self._opt_states.append(opt_state)
             self._param_shardings.append(p_shard)
             self._opt_shardings.append(o_shard)
-            self._acc_grads.append(jax.tree.map(
-                lambda v: jnp.zeros(v.shape, jnp.float32), params))
+            self._acc_grads.append(acc)
             # trace shapes through this stage for the next one's init
-            x = jax.eval_shape(
+            x_aval = jax.eval_shape(
                 lambda p, xv, m=mod: m.apply({"params": p}, xv,
                                              deterministic=True),
-                shapes, x)
-            x = jax.tree.map(
-                lambda sd: jnp.zeros(sd.shape, sd.dtype), x)
-            x = jax.device_put(
-                x, self.stage_topos[min(s + 1, self.num_stages - 1)]
-                .batch_sharding())
+                shapes, x_aval)
+            self._stage_out_avals.append(x_aval)
         self._sync_tied_params()
         self._initialized = True
         n = sum(int(np.prod(v.shape)) for p in self._params
-                for v in jax.tree.leaves(p))
+                if p is not None for v in jax.tree.leaves(p))
         log_dist(f"pipeline state materialized: {n/1e6:.1f}M params over "
-                 f"{self.num_stages} stages", ranks=[0])
+                 f"{self.num_stages} stages "
+                 f"(transport={self.transport_mode})", ranks=[0])
+
+    def _zeros_on_stage(self, aval_tree, s):
+        """Zeros with the stage's batch sharding, built in-program (no
+        host buffer; dispatched only by the stage's owners)."""
+        sharding = self.stage_topos[s].batch_sharding()
+        return jax.jit(
+            lambda: jax.tree.map(
+                lambda a: jnp.zeros(a.shape, a.dtype), aval_tree),
+            out_shardings=sharding)()
 
     # ------------------------------------------------------------------
     # per-stage compiled programs
@@ -396,9 +457,27 @@ class PipelineEngine:
         return inputs, labels
 
     def _put(self, tree, stage):
+        """Host batch -> the stage's sub-mesh. Multi-process: every
+        process sees the same GLOBAL batch (transport data contract) and
+        owners assemble their addressable shards of it; non-owners get
+        None (they never touch the stage's compute)."""
         sharding = self.stage_topos[stage].batch_sharding()
-        return jax.tree.map(
-            lambda v: jax.device_put(jnp.asarray(v), sharding), tree)
+        if not self._multiprocess:
+            return jax.tree.map(
+                lambda v: jax.device_put(jnp.asarray(v), sharding), tree)
+        if not self.transport.owns_stage(stage):
+            return None
+
+        def put_leaf(v):
+            v = np.asarray(v)
+            shards = [
+                jax.device_put(v[idx], dev) for dev, idx in
+                sharding.addressable_devices_indices_map(v.shape).items()
+            ]
+            return jax.make_array_from_single_device_arrays(
+                v.shape, sharding, shards)
+
+        return jax.tree.map(put_leaf, tree)
 
     def deepspeed_io(self, dataset, collate_fn=None, shuffle=True):
         global_micro = (self.train_micro_batch_size_per_gpu
@@ -429,12 +508,14 @@ class PipelineEngine:
             if self.curriculum_scheduler is not None:
                 batch = self._apply_curriculum(batch)
             x, lab = self._split_batch(batch)
+            if not self._initialized:
+                self._init_state(jax.tree.map(
+                    lambda v: jax.ShapeDtypeStruct(
+                        np.asarray(v).shape, np.asarray(v).dtype), x))
             with _phase("h2d"):
                 inputs.append(self._put(x, 0))
                 labels.append(self._put(lab, S - 1)
                               if lab is not None else None)
-        if not self._initialized:
-            self._init_state(inputs[0])
 
         self._rng, step_rng = jax.random.split(self._rng)
         rngs = [[jax.random.fold_in(jax.random.fold_in(step_rng, s), m)
@@ -446,50 +527,58 @@ class PipelineEngine:
         grads_in: Dict[int, Any] = {}            # mb -> g wrt next-stage input
         losses = []
 
+        owns = self.transport.owns_stage
         sched = TrainSchedule(M, S)
         with _phase("compiled_step"):
             for clock in sched.clocks():
                 for ins in clock:
                     s, m = ins.stage, ins.micro_batch
                     if ins.op == "load":
-                        acts[(0, m)] = inputs[m]
+                        if owns(0):
+                            acts[(0, m)] = inputs[m]
                     elif ins.op == "forward":
-                        x = acts[(s, m)]
-                        if s < S - 1:
-                            fargs = (self._params[s], x, rngs[s][m]) + (
-                                (theta,) if theta is not None else ())
-                            self._note_mem_call(f"fwd_stage{s}",
-                                                self._fwd_fn(s), fargs)
-                            out = self._fwd_fn(s)(*fargs)
-                            acts[(s + 1, m)] = jax.device_put(
-                                out, self.stage_topos[s + 1].batch_sharding())
                         # last stage fwd is fused into its backward
-                        # (recompute)
+                        # (recompute); transfers run on EVERY process —
+                        # ppermute is a joint-mesh collective
+                        if s < S - 1:
+                            out = None
+                            if owns(s):
+                                x = acts[(s, m)]
+                                fargs = (self._params[s], x, rngs[s][m]) + (
+                                    (theta,) if theta is not None else ())
+                                self._note_mem_call(f"fwd_stage{s}",
+                                                    self._fwd_fn(s), fargs)
+                                out = self._fwd_fn(s)(*fargs)
+                            nxt = self.transport.send_forward(
+                                out, s, self._stage_out_avals[s])
+                            if owns(s + 1):
+                                acts[(s + 1, m)] = nxt
                     elif ins.op == "backward":
-                        x = acts[(s, m)]
-                        textra = (theta,) if theta is not None else ()
-                        if s == S - 1:
-                            bargs = (self._params[s], x, labels[m],
-                                     rngs[s][m]) + textra
-                            self._note_mem_call(f"bwd_stage{s}",
-                                                self._bwd_fn(s), bargs)
-                            gp, gx, loss = self._bwd_fn(s)(*bargs)
-                            losses.append(loss)
-                        else:
-                            g = grads_in.pop(m)
-                            bargs = (self._params[s], x, g,
-                                     rngs[s][m]) + textra
-                            self._note_mem_call(f"bwd_stage{s}",
-                                                self._bwd_fn(s), bargs)
-                            gp, gx = self._bwd_fn(s)(*bargs)
-                        self._acc_grads[s] = jax.tree.map(
-                            jnp.add, self._acc_grads[s], gp)
+                        gx = None
+                        if owns(s):
+                            x = acts.pop((s, m))
+                            textra = (theta,) if theta is not None else ()
+                            if s == S - 1:
+                                bargs = (self._params[s], x, labels[m],
+                                         rngs[s][m]) + textra
+                                self._note_mem_call(f"bwd_stage{s}",
+                                                    self._bwd_fn(s), bargs)
+                                gp, gx, loss = self._bwd_fn(s)(*bargs)
+                                losses.append(loss)
+                            else:
+                                g = grads_in.pop(m)
+                                bargs = (self._params[s], x, g,
+                                         rngs[s][m]) + textra
+                                self._note_mem_call(f"bwd_stage{s}",
+                                                    self._bwd_fn(s), bargs)
+                                gp, gx = self._bwd_fn(s)(*bargs)
+                            self._acc_grads[s] = jax.tree.map(
+                                jnp.add, self._acc_grads[s], gp)
                         if s > 0:
-                            grads_in[m] = jax.device_put(
-                                gx, self.stage_topos[s - 1].batch_sharding())
-                            del acts[(s, m)]
-                        else:
-                            del acts[(s, m)]
+                            gprev = self.transport.send_backward(
+                                gx, s, self._stage_in_avals[s])
+                            if owns(s - 1):
+                                grads_in[m] = gprev
 
             self._sync_tied_grads()
         with _phase("optimizer"):
@@ -504,7 +593,19 @@ class PipelineEngine:
             prof.end_step(self.global_steps)
             if self._mem_programs and not prof.has_memory():
                 self._capture_compiled_memory()
-        mean_loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
+        if self._multiprocess:
+            # broadcast the last stage's per-microbatch losses to every
+            # process through the [S]-slot psum (collective: all call it)
+            contribs = {}
+            if owns(S - 1):
+                contribs[S - 1] = np.stack(
+                    [np.asarray(l, np.float32) for l in losses])
+            loss_vec = self.transport.psum_stage_scalars(
+                contribs, shape=(M,))
+            mean_loss = jnp.asarray(loss_vec.mean(), jnp.float32)
+        else:
+            mean_loss = jnp.mean(
+                jnp.stack([jnp.asarray(l) for l in losses]))
         if self.global_steps % self._config.steps_per_print == 0:
             log_dist(f"pipe step={self.global_steps} loss={float(mean_loss):.4f}",
                      ranks=[0])
@@ -552,17 +653,42 @@ class PipelineEngine:
         set_default_topology(self.topology)
         x, labels = self._split_batch(batch)
         if not self._initialized:
-            self._init_state(self._put(x, 0))
+            self._init_state(jax.tree.map(
+                lambda v: jax.ShapeDtypeStruct(
+                    np.asarray(v).shape, np.asarray(v).dtype), x))
+        owns = self.transport.owns_stage
+        # eval batches need not match the training batch shape: re-derive
+        # activation avals for THIS batch (host-side, every process)
+        aval = jax.tree.map(
+            lambda v: jax.ShapeDtypeStruct(
+                np.asarray(v).shape, np.asarray(v).dtype), x)
+        out_avals = []
+        for s in range(self.num_stages):
+            aval = jax.eval_shape(
+                lambda p, xv, m=self.stage_modules[s]: m.apply(
+                    {"params": p}, xv, deterministic=True),
+                self._stage_param_shapes[s], aval)
+            out_avals.append(aval)
         x = self._put(x, 0)
         for s in range(self.num_stages - 1):
-            x = self.stage_modules[s].apply(
-                {"params": self._params[s]}, x, deterministic=True)
-            x = jax.device_put(x, self.stage_topos[s + 1].batch_sharding())
+            out = None
+            if owns(s):
+                out = self.stage_modules[s].apply(
+                    {"params": self._params[s]}, x, deterministic=True)
+            x = self.transport.send_forward(out, s, out_avals[s])
         s = self.num_stages - 1
-        out = self.stage_modules[s].apply(
-            {"params": self._params[s]}, x, deterministic=True)
-        if labels is not None and self.module.loss_fn is not None:
-            return self.module.loss_fn(out, self._put(labels, s))
+        out = None
+        if owns(s):
+            out = self.stage_modules[s].apply(
+                {"params": self._params[s]}, x, deterministic=True)
+            if labels is not None and self.module.loss_fn is not None:
+                out = self.module.loss_fn(out, self._put(labels, s))
+        if self._multiprocess and labels is not None \
+                and self.module.loss_fn is not None:
+            # scalar loss: broadcast so every process returns the same
+            val = self.transport.psum_stage_scalars(
+                {s: out} if owns(s) else {})
+            return jnp.asarray(val, jnp.float32)
         return out
 
     # ------------------------------------------------------------------
@@ -606,14 +732,26 @@ class PipelineEngine:
         # scale, so no extra factor here
         factor = 1.0
         if self.gradient_clipping and self.gradient_clipping > 0:
-            sq = 0.0
-            for s in range(self.num_stages):
-                sq += float(optax.global_norm(self._acc_grads[s]) ** 2)
+            if self._multiprocess:
+                # cross-stage norm needs every stage's contribution; the
+                # [S]-slot psum is the collective every process joins
+                contribs = {
+                    s: float(optax.global_norm(self._acc_grads[s]) ** 2)
+                    for s in range(self.num_stages)
+                    if self.transport.owns_stage(s)
+                }
+                sq = float(self.transport.psum_stage_scalars(contribs))
+            else:
+                sq = 0.0
+                for s in range(self.num_stages):
+                    sq += float(optax.global_norm(self._acc_grads[s]) ** 2)
             gnorm = float(np.sqrt(sq))
             clip = min(1.0, self.gradient_clipping / (gnorm + 1e-6))
         else:
             clip = 1.0
         for s in range(self.num_stages):
+            if not self.transport.owns_stage(s):
+                continue
             aargs = (self._params[s], self._opt_states[s],
                      self._acc_grads[s], jnp.float32(clip * factor))
             self._note_mem_call(f"apply_stage{s}", self._apply_fn(s), aargs)
@@ -652,6 +790,11 @@ class PipelineEngine:
             save_dir, str(tag), "layer_bounds_*_optim_states.msgpack")))
         written = set()
         for s in range(self.num_stages):
+            # multi-process: each stage's files are written once, by the
+            # lowest-indexed owning process (layout is transport- and
+            # process-count-invariant: global layer names, same bounds)
+            if not self._stage_first_owner(s):
+                continue
             stem = (f"layer_bounds_{self.stage_bounds[s]}_"
                     f"{self.stage_bounds[s+1]}")
             path = os.path.join(save_dir, str(tag),
@@ -673,17 +816,19 @@ class PipelineEngine:
         # difficulty, PLD theta, lr warmup) from zero. Saved through the
         # checkpoint engine (pickled bytes in a msgpack envelope) so the
         # meta shares the commit durability barrier with the stage files.
-        meta = {
-            "global_steps": self.global_steps,
-            "global_samples": self.global_samples,
-            "micro_steps": self.micro_steps,
-            "lr_scheduler": (self.lr_scheduler.state_dict()
-                             if self.lr_scheduler else {}),
-            "client_state": client_state or {},
-        }
-        self.checkpoint_engine.save(
-            {"meta": np.frombuffer(pickle.dumps(meta), np.uint8)},
-            os.path.join(save_dir, str(tag), "pipe_engine_states.msgpack"))
+        if jax.process_index() == 0:
+            meta = {
+                "global_steps": self.global_steps,
+                "global_samples": self.global_samples,
+                "micro_steps": self.micro_steps,
+                "lr_scheduler": (self.lr_scheduler.state_dict()
+                                 if self.lr_scheduler else {}),
+                "client_state": client_state or {},
+            }
+            self.checkpoint_engine.save(
+                {"meta": np.frombuffer(pickle.dumps(meta), np.uint8)},
+                os.path.join(save_dir, str(tag),
+                             "pipe_engine_states.msgpack"))
         # durability barrier BEFORE advertising 'latest' (async engine:
         # save() only enqueues; files land at commit)
         self.checkpoint_engine.commit(tag)
@@ -691,6 +836,10 @@ class PipelineEngine:
         # pipeline degree (their bounds-keyed names differ, and a merging
         # load could pick them up): a crash any earlier leaves the
         # previous complete set on disk
+        if self._multiprocess:
+            # each process only knows the files ITS stages wrote; purging
+            # by local difference would delete peers' fresh files
+            pre_existing = written = set()
         for stale in sorted(pre_existing - written):
             try:
                 os.remove(stale)
@@ -708,11 +857,20 @@ class PipelineEngine:
                     "(%s); a later load at a different pipeline degree "
                     "may merge its outdated layers — remove it manually",
                     stale, e)
-        if save_latest:
+        if save_latest and jax.process_index() == 0:
             from deepspeed_tpu.runtime import checkpoint_manifest
 
             checkpoint_manifest.write_latest(save_dir, tag)
         return True
+
+    def _stage_first_owner(self, s: int) -> bool:
+        """True when this process is the lowest-indexed owner of stage
+        ``s`` (single process: always True for every stage)."""
+        if not self.transport.owns_stage(s):
+            return False
+        first = min(d.process_index
+                    for d in self.stage_topos[s].mesh.devices.flat)
+        return first == jax.process_index()
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True, **_):
@@ -752,6 +910,8 @@ class PipelineEngine:
         for f in files:
             merged.update(self.checkpoint_engine.load(f)["module"])
         for s in range(self.num_stages):
+            if not self.transport.owns_stage(s):
+                continue
             want = set(self._params[s])
             missing = want - set(merged)
             if missing:
@@ -784,6 +944,8 @@ class PipelineEngine:
             if same_degree:
                 restored_any = False
                 for s in range(self.num_stages):
+                    if not self.transport.owns_stage(s):
+                        continue
                     opath = os.path.join(
                         load_dir, str(tag),
                         f"layer_bounds_{self.stage_bounds[s]}_"

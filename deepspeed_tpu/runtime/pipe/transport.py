@@ -1,0 +1,258 @@
+"""Pipeline stage-to-stage activation transport.
+
+The 1F1B interpreter (``pipe/engine.py``) is host-driven: per-stage
+compute runs as separate jitted programs on per-stage sub-meshes. What
+moves BETWEEN stages is the transport, and this module gives it two
+implementations behind one API (``tpu.pipeline.transport``):
+
+* ``device_put`` — the original host-level cross-mesh copy. Fast and
+  simple in a single process, but it is a host-mediated transfer XLA can
+  never overlap with compute, and on a multi-process mesh it needs the
+  backend's cross-host transfer server (the CPU backend has none — the
+  path hangs; see tests/unit/test_multihost.py).
+
+* ``ppermute`` — the transfer re-expressed as an IN-PROGRAM collective
+  over the JOINT ``(pp, dp, ...)`` mesh: every stage's shard of a
+  ``[S, ...]``-stacked payload hops one ``pp`` coordinate per
+  ``lax.ppermute`` (forward ``s -> s+1``, backward ``s+1 -> s``). The
+  source stage contributes its real activation shards; every other pp
+  coordinate contributes cached zero filler, so the one compiled shift
+  program serves every hop of every micro batch. Filler hops ride
+  otherwise-idle links in parallel with the real payload — per-device
+  wire bytes equal the real transfer. Because the collective is a joint-
+  mesh program, EVERY process participates (McJAX SPMD: all processes
+  owning mesh devices must dispatch the same program), which is exactly
+  what makes multi-process pipeline parallelism work where cross-mesh
+  ``device_put`` cannot.
+
+Ownership: a process "owns" a stage when it addresses at least one
+device of that stage's sub-mesh. Per-stage compute must only be
+dispatched by owners (a jit over a fully non-addressable mesh is
+illegal); the joint-mesh transport and the ``[S]``-slot scalar psum are
+dispatched by everyone. The transport never touches checkpoint layout —
+both modes see identical per-stage param trees.
+
+Multi-process data contract: every process must feed ``train_batch`` the
+same GLOBAL batch stream (the standard McJAX pattern — each process
+slices out its addressable shards in ``_put``).
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.logging import comms_logger
+from deepspeed_tpu.parallel.mesh import BATCH_AXES
+
+
+def resolve_transport(configured: str) -> str:
+    """``auto`` -> ppermute across processes, device_put within one."""
+    if configured == "auto":
+        return "ppermute" if jax.process_count() > 1 else "device_put"
+    return configured
+
+
+class StageTransport:
+    """Stage-to-stage transfer over a joint mesh (or cross-mesh puts)."""
+
+    def __init__(self, topology, stage_topos: List, mode: str):
+        assert mode in ("ppermute", "device_put"), mode
+        self.topology = topology
+        self.stage_topos = stage_topos
+        self.mode = mode
+        self.num_stages = len(stage_topos)
+        self.multiprocess = jax.process_count() > 1
+        pid = jax.process_index()
+        self._owns = [
+            any(d.process_index == pid for d in t.mesh.devices.flat)
+            for t in stage_topos
+        ]
+        self._dev_stage: Dict[Any, int] = {}
+        for s, t in enumerate(stage_topos):
+            for d in t.mesh.devices.flat:
+                self._dev_stage[d] = s
+        self._batch_axes = tuple(
+            a for a in BATCH_AXES if topology.size(a) > 1)
+        self._filler: Dict[Tuple, Any] = {}
+        self._shift_fns: Dict[Tuple, Any] = {}
+        self._psum_fns: Dict[Tuple, Any] = {}
+
+    # ------------------------------------------------------------------
+    def owns_stage(self, s: int) -> bool:
+        """Whether this process addresses any device of stage ``s``."""
+        return self._owns[s]
+
+    def send_forward(self, tree: Optional[Any], from_stage: int,
+                     avals: Any) -> Optional[Any]:
+        """Move ``tree`` from ``from_stage`` to ``from_stage + 1``.
+
+        ``tree`` is the source stage's output (None on processes that do
+        not own the source); ``avals`` its ShapeDtypeStruct tree (known
+        host-side everywhere from the init-time eval_shape chain).
+        Returns the received tree on owners of the destination stage,
+        None elsewhere. ALL processes must call this in ppermute mode —
+        the shift is a joint-mesh collective.
+        """
+        if self.mode == "device_put":
+            sharding = self.stage_topos[from_stage + 1].batch_sharding()
+            return jax.tree.map(
+                lambda v: jax.device_put(v, sharding), tree)
+        return self._hop(tree, from_stage, from_stage + 1, avals, "fwd")
+
+    def send_backward(self, tree: Optional[Any], from_stage: int,
+                      avals: Any) -> Optional[Any]:
+        """Move a cotangent tree from ``from_stage`` to ``from_stage - 1``
+        (same contract as :meth:`send_forward`)."""
+        if self.mode == "device_put":
+            sharding = self.stage_topos[from_stage - 1].batch_sharding()
+            return jax.tree.map(
+                lambda v: jax.device_put(v, sharding), tree)
+        return self._hop(tree, from_stage, from_stage - 1, avals, "bwd")
+
+    def psum_stage_scalars(self, contribs: Dict[int, Any],
+                           shape: Tuple[int, ...] = (),
+                           dtype=np.float32) -> np.ndarray:
+        """Sum per-stage host-readable values across stages; every process
+        gets the (replicated) result. ``contribs[s]`` is stage ``s``'s
+        ``shape``-shaped contribution, supplied by its owner(s) — owners
+        of the same stage must supply the same value (it fills the same
+        ``[S]``-slot once, not additively). Used for the cross-stage grad
+        norm and for broadcasting the last stage's losses; in device_put
+        (single-controller) mode it is a plain host sum.
+        """
+        if self.mode == "device_put":
+            total = np.zeros(shape, dtype)
+            for v in contribs.values():
+                total = total + np.asarray(v, dtype)
+            return total
+        S = self.num_stages
+        gshape = (S,) + tuple(shape)
+        sh = NamedSharding(self.topology.mesh, P("pp"))
+        host = {s: np.asarray(v, dtype).reshape(shape)
+                for s, v in contribs.items()}
+        zero = np.zeros((1,) + tuple(shape), dtype)
+        arrays = []
+        for dev in sh.addressable_devices_indices_map(gshape):
+            v = host.get(self._dev_stage[dev])
+            arrays.append(jax.device_put(
+                zero if v is None else v[None], dev))
+        joint = jax.make_array_from_single_device_arrays(gshape, sh, arrays)
+        out = self._psum_fn(tuple(shape), np.dtype(dtype).str)(joint)
+        return np.asarray(out.addressable_shards[0].data[0])
+
+    # ------------------------------------------------------------------
+    def _leaf_spec(self, aval) -> P:
+        if self._batch_axes and len(aval.shape) >= 1:
+            return P("pp", self._batch_axes)
+        return P("pp")
+
+    def _hop(self, tree, src, dst, avals, direction):
+        aval_leaves, treedef = jax.tree.flatten(avals)
+        if tree is not None:
+            src_leaves = [self._canon(l, src)
+                          for l in jax.tree.leaves(tree)]
+            assert len(src_leaves) == len(aval_leaves), (
+                f"stage {src} produced {len(src_leaves)} leaves but its "
+                f"recorded avals have {len(aval_leaves)}")
+        else:
+            src_leaves = [None] * len(aval_leaves)
+        joint = tuple(self._to_joint(l, a, src)
+                      for l, a in zip(src_leaves, aval_leaves))
+        specs = tuple(self._leaf_spec(a) for a in aval_leaves)
+        shifted = self._shift_fn(direction, specs)(*joint)
+        if not self._owns[dst]:
+            return None
+        out = [self._from_joint(j, a, dst)
+               for j, a in zip(shifted, aval_leaves)]
+        return jax.tree.unflatten(treedef, out)
+
+    def _canon(self, leaf, stage):
+        """Pin a source leaf to the stage's canonical batch sharding (a
+        per-stage jit usually already produced exactly that; a mismatch
+        reshards within the sub-mesh)."""
+        sharding = self.stage_topos[stage].batch_sharding()
+        if leaf.sharding.is_equivalent_to(sharding, leaf.ndim):
+            return leaf
+        return jax.device_put(leaf, sharding)
+
+    def _to_joint(self, leaf, aval, src):
+        """Stack one stage-local leaf into the ``[S, ...]`` joint-mesh
+        array: the source stage's devices contribute their real shards
+        (on-device reshape, no copy off the device), every other pp
+        coordinate gets cached zero filler."""
+        S = self.num_stages
+        sh = NamedSharding(self.topology.mesh, self._leaf_spec(aval))
+        gshape = (S,) + tuple(aval.shape)
+        fshape = sh.shard_shape(gshape)
+        shard_by_dev = ({s.device: s.data for s in leaf.addressable_shards}
+                       if leaf is not None else {})
+        arrays = []
+        for dev in sh.addressable_devices_indices_map(gshape):
+            piece = shard_by_dev.get(dev)
+            arrays.append(self._zero_filler(dev, fshape, aval.dtype)
+                          if piece is None else piece[None])
+        return jax.make_array_from_single_device_arrays(gshape, sh, arrays)
+
+    def _from_joint(self, joint, aval, dst):
+        """Extract the destination stage's slot from the shifted joint
+        array as a sub-mesh array in the stage's batch sharding."""
+        sub = self.stage_topos[dst].batch_sharding()
+        gshape = tuple(aval.shape)
+        shard_by_dev = {s.device: s.data for s in joint.addressable_shards}
+        arrays = [shard_by_dev[dev][0]
+                  for dev in sub.addressable_devices_indices_map(gshape)]
+        return jax.make_array_from_single_device_arrays(gshape, sub, arrays)
+
+    def _zero_filler(self, dev, shape, dtype):
+        key = (dev.id, tuple(shape), np.dtype(dtype).str)
+        z = self._filler.get(key)
+        if z is None:
+            z = jax.device_put(np.zeros(shape, dtype), dev)
+            self._filler[key] = z
+        return z
+
+    def _shift_fn(self, direction, specs):
+        """One jitted joint-mesh ppermute per (direction, leaf-spec
+        tuple); jax.jit's aval cache makes it serve every hop, micro
+        batch, and step."""
+        key = (direction, specs)
+        fn = self._shift_fns.get(key)
+        if fn is None:
+            S = self.num_stages
+            perm = ([(s, s + 1) for s in range(S - 1)] if direction == "fwd"
+                    else [(s, s - 1) for s in range(1, S)])
+
+            def shift(*leaves):
+                out = []
+                for x in leaves:
+                    # trace-time wire metering: x is the per-device block,
+                    # so bytes are the real per-device payload (filler
+                    # hops ride idle links in parallel — not extra wire
+                    # on the payload path)
+                    comms_logger.append(
+                        "ppermute", x, "pp",
+                        log_name=f"pipe_transfer.{direction}", world=S)
+                    out.append(lax.ppermute(x, "pp", perm))
+                return tuple(out)
+
+            fn = jax.jit(jax.shard_map(
+                shift, mesh=self.topology.mesh, in_specs=specs,
+                out_specs=specs, check_vma=False))
+            self._shift_fns[key] = fn
+        return fn
+
+    def _psum_fn(self, shape, dtype_str):
+        key = (tuple(shape), dtype_str)
+        fn = self._psum_fns.get(key)
+        if fn is None:
+            def f(x):
+                return lax.psum(x, "pp")
+
+            fn = jax.jit(jax.shard_map(
+                f, mesh=self.topology.mesh, in_specs=P("pp"),
+                out_specs=P("pp"), check_vma=False))
+            self._psum_fns[key] = fn
+        return fn

@@ -38,6 +38,7 @@ KIND_CKPT_COMMIT = "checkpoint.commit"
 KIND_CKPT_FALLBACK = "checkpoint.fallback"
 KIND_RING_DECLINE = "ring.decline"
 KIND_BUCKET_PLAN = "comm.bucket_plan"
+KIND_COMM_HIERARCHY = "comm.hierarchy_plan"
 KIND_PREFETCH_STARVED = "data.prefetch_starved"
 KIND_SERVE_ADMIT = "serve.admit"
 KIND_SERVE_EVICT = "serve.evict"

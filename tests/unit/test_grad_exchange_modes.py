@@ -26,6 +26,7 @@ from tests.unit.test_engine_compressed import (
     _compiled_step_text,
     _data,
     _engine,
+    _has_int8_collective,
 )
 
 
@@ -165,3 +166,124 @@ class TestBucketedInt8:
         assert isinstance(we, tuple) and len(we) == plan.num_buckets
         # residuals are live (non-zero) after compressed steps
         assert max(np.abs(np.asarray(e)).max() for e in we) > 0
+
+
+class TestHierarchicalExchange:
+    """Two-level ICI/DCN deferred exchange (``hierarchical`` +
+    ``dcn_slices`` forcing the slice structure on the virtual CPU mesh)."""
+
+    def test_config_rejects_unknown_mode(self):
+        with pytest.raises(DeepSpeedConfigError, match="hierarchical"):
+            GradExchangeConfig.from_dict({"hierarchical": "yes"})
+        with pytest.raises(DeepSpeedConfigError, match="dcn_slices"):
+            GradExchangeConfig.from_dict({"dcn_slices": -2})
+        with pytest.raises(DeepSpeedConfigError, match="dcn_block"):
+            GradExchangeConfig.from_dict({"dcn_block": 0})
+
+    def test_on_requires_deferred(self, eight_devices):
+        with pytest.raises(ValueError, match="deferred"):
+            _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                    extra={"tpu": {"grad_exchange":
+                                   {"hierarchical": "on"}}})
+
+    def test_rejected_on_int8_wire(self, eight_devices):
+        # the int8 path owns its wire format end to end
+        with pytest.raises(ValueError, match="deferred"):
+            _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                    extra={"communication_data_type": "int8",
+                           "tpu": {"grad_exchange":
+                                   {"hierarchical": "auto"}}})
+
+    def test_on_without_slice_structure_raises(self, eight_devices):
+        # single-slice CPU mesh, no dcn_slices override: "on" must fail
+        # loudly instead of silently running the flat exchange. The
+        # layout is resolved with the rest of the lazily-built state, so
+        # the error surfaces on the first batch.
+        X, Y = _data()
+        eng = _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                      extra={"tpu": {"grad_exchange":
+                                     {"deferred": True,
+                                      "hierarchical": "on"}}})
+        it = iter(RepeatingLoader([{"x": X, "y": Y}]))
+        with pytest.raises(ValueError, match="slice structure"):
+            eng.train_batch(it)
+
+    def test_indivisible_slice_count_raises(self, eight_devices):
+        X, Y = _data()
+        eng = _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                      extra={"tpu": {"grad_exchange":
+                                     {"deferred": True,
+                                      "hierarchical": "on",
+                                      "dcn_slices": 3}}})
+        it = iter(RepeatingLoader([{"x": X, "y": Y}]))
+        with pytest.raises(ValueError, match="do not divide"):
+            eng.train_batch(it)
+
+    def test_auto_without_slices_falls_back_flat(self, eight_devices):
+        X, Y = _data()
+        eng = _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                      extra={"tpu": {"grad_exchange":
+                                     {"deferred": True,
+                                      "hierarchical": "auto"}}})
+        it = iter(RepeatingLoader([{"x": X, "y": Y}]))
+        eng.train_batch(it)  # builds the (lazy) exchange state
+        assert eng._compressed_mode == "deferred"
+        assert eng._gx_num_slices == 1
+
+    @pytest.mark.slow
+    def test_converges_publishes_plan_and_int8_dcn_wire(
+            self, eight_devices):
+        from deepspeed_tpu.telemetry.bus import (KIND_COMM_HIERARCHY,
+                                                 telemetry_bus)
+
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        eng = _engine({"type": "AdamW", "params": {"lr": 5e-2}},
+                      extra={"tpu": {"grad_exchange":
+                                     {"deferred": True, "bucket_mb": 1,
+                                      "hierarchical": "auto",
+                                      "dcn_slices": 2,
+                                      "dcn_block": 64}}})
+        it = iter(RepeatingLoader([batch]))
+        evs = []
+        telemetry_bus.subscribe(evs.append)
+        try:
+            first = float(eng.train_batch(it))  # lazy state init publishes
+        finally:
+            telemetry_bus.unsubscribe(evs.append)
+        assert eng._compressed_mode == "deferred"
+        assert eng._gx_num_slices == 2
+        plans = [e for e in evs if e["kind"] == KIND_COMM_HIERARCHY]
+        assert len(plans) == 1, [e["kind"] for e in evs]
+        assert plans[0]["world"] == 8 and plans[0]["num_slices"] == 2
+        assert plans[0]["per_slice"] == 4 and plans[0]["dcn_wire"] == "int8"
+        # the inter-slice leg rides the EQuARX int8 wire format
+        assert _has_int8_collective(_compiled_step_text(eng, batch))
+        losses = [first] + [float(eng.train_batch(it)) for _ in range(99)]
+        assert losses[-1] < 0.01 * losses[0], losses[::20]
+
+    @pytest.mark.slow
+    def test_tracks_flat_deferred_exchange(self, eight_devices):
+        """The hierarchy changes WHERE the reduction happens (and puts the
+        1/P DCN shard on an int8 wire); early-training trajectories must
+        track the flat deferred exchange closely."""
+        X, Y = _data()
+        batch = {"x": X, "y": Y}
+        runs = {}
+        for name, gx in [
+            ("flat", {"deferred": True, "bucket_mb": 1}),
+            ("hier", {"deferred": True, "bucket_mb": 1,
+                      "hierarchical": "on", "dcn_slices": 2,
+                      "dcn_block": 64}),
+        ]:
+            from deepspeed_tpu.parallel import mesh
+            mesh.reset_default_topology()
+            eng = _engine({"type": "AdamW", "params": {"lr": 1e-2}},
+                          extra={"tpu": {"grad_exchange": gx}})
+            it = iter(RepeatingLoader([batch]))
+            losses = [float(eng.train_batch(it)) for _ in range(12)]
+            runs[name] = (losses, _params(eng))
+        np.testing.assert_allclose(runs["flat"][0], runs["hier"][0],
+                                   rtol=0.05)
+        for f, h in zip(runs["flat"][1], runs["hier"][1]):
+            np.testing.assert_allclose(f, h, atol=0.05)

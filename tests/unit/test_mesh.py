@@ -109,6 +109,40 @@ def test_derive_dcn_shape_prefers_outer_axes():
         (2, 2, 1, 1, 1, 1)
 
 
+def test_derive_dcn_shape_fsdp_absorbs_when_outer_axes_cannot():
+    from deepspeed_tpu.parallel.mesh import MeshTopology
+
+    # pp=1, dp=1: fsdp is the outermost axis able to absorb the slices
+    assert MeshTopology._derive_dcn_shape((1, 1, 4, 1, 1, 2), 4) == \
+        (1, 1, 4, 1, 1, 1)
+    # odd slice count rides whichever outer axis shares the factor
+    assert MeshTopology._derive_dcn_shape((1, 3, 2, 1, 1, 1), 3) == \
+        (1, 3, 1, 1, 1, 1)
+
+
+def test_derive_dcn_shape_splits_factor_across_outer_axes():
+    from deepspeed_tpu.parallel.mesh import MeshTopology
+
+    # 4 slices, no single outer axis holds 4: pp takes 2, fsdp takes 2
+    assert MeshTopology._derive_dcn_shape((2, 1, 2, 1, 1, 2), 4) == \
+        (2, 1, 2, 1, 1, 1)
+    # 6 slices = pp 2 x dp 3
+    assert MeshTopology._derive_dcn_shape((2, 3, 1, 1, 1, 1), 6) == \
+        (2, 3, 1, 1, 1, 1)
+
+
+def test_derive_dcn_shape_indivisible_count_fails_loudly():
+    from deepspeed_tpu.parallel.mesh import MeshTopology
+
+    # 3 slices over all-even outer axes: gcd absorbs nothing, and the
+    # error must name the leftover factor rather than mis-shape the mesh
+    with pytest.raises(ValueError, match="factor of 3"):
+        MeshTopology._derive_dcn_shape((2, 2, 2, 1, 1, 1), 3)
+    # partial absorption (4 of 8) still errors on the remainder
+    with pytest.raises(ValueError, match="pp/dp/fsdp"):
+        MeshTopology._derive_dcn_shape((2, 2, 1, 1, 1, 2), 8)
+
+
 def test_derive_dcn_shape_rejects_tp_only_split():
     from deepspeed_tpu.parallel.mesh import MeshTopology
 

@@ -22,13 +22,18 @@ training program whose collectives span the process boundary:
   AXIS_ORDER, any dp>1 split would leave each ep group intra-process),
   so the expert-dispatch all-to-all crosses hosts (reference
   moe/sharded_moe.py _AllToAll over the expert-parallel group).
-With these five, every compiled-collective mesh axis (dp, fsdp, tp, sp,
-ep) runs across a real process boundary. Pipeline (pp) inter-stage
-transfers are host-level cross-mesh device_puts — on a real pod they ride
-jax's DCN transfer path (``jax_cross_host_transfer_socket_address``); the
-CPU backend's transfer server cannot emulate that here (verified: the
-flagged path hangs on the virtual mesh), so multi-host pp is exercised by
-the driver's TPU-side dryrun instead.
+* ``pp2``    — pipeline parallelism over pp=2 x dp=4 with
+  ``tpu.pipeline.transport: ppermute``: stage-to-stage activation and
+  cotangent hops are in-program ``lax.ppermute`` collectives over the
+  joint mesh, so they cross the process boundary like any other
+  compiled collective (pipe/transport.py).
+With these six, every mesh axis (dp, fsdp, tp, sp, ep, pp) runs across
+a real process boundary on this virtual CPU mesh. Only the legacy
+``transport: device_put`` pipeline path remains TPU-only: cross-mesh
+device_put rides jax's DCN transfer path
+(``jax_cross_host_transfer_socket_address``), and the CPU backend has no
+transfer server to emulate it (verified: that path — and only that
+path — hangs on the virtual mesh).
 
 Each child's loss stream is compared against a single-process 8-device run
 of the identical scenario, so cross-host execution is held to numerical
@@ -127,6 +132,24 @@ def run_case(name):
                               param_dtype=jnp.float32, scan_layers=False,
                               moe_num_experts=8, moe_top_k=2))
         it = _token_batches(16)  # dp_size = ep = 8; micro 2 each
+    elif name == "pp2":
+        # pipeline over pp=2 x dp=4; ppermute transport makes the
+        # stage hops joint-mesh collectives (each stage's sub-mesh is
+        # fully inside one process here, so compute gating is exercised
+        # too: each process runs only its own stage's programs)
+        from deepspeed_tpu.models.pipeline_gpt import gpt_pipeline
+        from deepspeed_tpu.models.transformer_lm import GPTConfig
+        cfg = dict(base, train_micro_batch_size_per_gpu=2,
+                   gradient_accumulation_steps=2,
+                   gradient_clipping=1.0,
+                   tpu={"mesh": {"pp": 2, "dp": 4},
+                        "pipeline": {"transport": "ppermute"}})
+        model = gpt_pipeline(
+            GPTConfig(vocab_size=128, n_positions=32, n_embd=32,
+                      n_layer=4, n_head=4, dtype=jnp.float32,
+                      param_dtype=jnp.float32, scan_layers=False),
+            num_stages=2)
+        it = _token_batches(8)  # dp=4 x micro 2, global batch each hop
     elif name == "infer_int8_tp8":
         # int8 weight-only SERVING with tp=8 spanning both processes:
         # the {q, scale} shards and the row-parallel activation psums
@@ -259,7 +282,7 @@ def _spawn_pair(case, tmp_path):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("case", ["stage2", "stage3", "tp8", "sp_ring",
-                                  "moe_ep"])
+                                  "moe_ep", "pp2"])
 def test_two_process_training_matches_single_host(case, eight_devices,
                                                   tmp_path):
     losses_ref = _single_process_reference(case)
