@@ -12,13 +12,23 @@
 #                preemption grace saves, crash-loop detection, elastic
 #                topology resume (8->4 / 4->8 kill-and-reshard), the
 #                training health sentinel: NaN/spike anomalies, auto-
-#                rollback, hang watchdog (docs/recovery.md), and the
-#                serving-fleet failover units
+#                rollback, hang watchdog (docs/recovery.md), the
+#                serving-fleet failover units, and the cluster health
+#                plane units (silence schedule, coordinated abort, SDC
+#                digest cross-check) — runs chaos-cluster first
 #   make chaos-serve  kill-a-replica-mid-decode scenario: one of N
 #                serving replicas is SIGKILLed while decoding; asserts
 #                zero lost requests, token-identical failover replays,
 #                and one serve.failover per migrated request (commits
 #                benchmarks/inference/failover_bench_results.json)
+#   make chaos-cluster  cluster-health scenarios on a REAL 2-process
+#                world: SIGSTOP one rank of a pp=2 run (survivor exits
+#                15 within the silence budget, ONE world relaunch,
+#                resume on-trajectory) and a silent bit flip in a
+#                replicated weight (digest probe catches it within K
+#                steps, crc-valid blackbox, rollback on-trajectory) —
+#                docs/recovery.md "Cluster health & SDC defense"
+#                (commits benchmarks/chaos_cluster_results.json)
 #   make profile step-profiler gate on a tiny CPU config: asserts phase
 #                breakdown sums to step wall time, analytic MFU from the
 #                compiled step, and a perfetto-loadable trace
@@ -78,7 +88,8 @@ HOT_PATHS := deepspeed_tpu/runtime/engine.py deepspeed_tpu/models \
              deepspeed_tpu/inference/engine.py \
              deepspeed_tpu/runtime/step_autotune.py
 
-.PHONY: quick test smoke chaos chaos-serve profile blackbox memreport \
+.PHONY: quick test smoke chaos chaos-serve chaos-cluster profile \
+        blackbox memreport \
         check hooks hot-changed serve-bench serve-bench-uniform \
         serve-bench-disagg data-bench \
         dryrun mfu-search mfu-search-full overlap-measured \
@@ -102,6 +113,7 @@ quick:
 	  tests/unit/test_data_pipeline.py tests/unit/test_telemetry.py \
 	  tests/unit/test_step_autotune.py \
 	  tests/unit/test_elastic_reshard.py \
+	  tests/unit/test_health_state.py tests/unit/test_cluster_health.py \
 	  -q -x -m "not slow"
 
 test:
@@ -113,9 +125,18 @@ smoke:
 # includes the elastic 8->4 / 4->8 topology-resume scenarios (train on N
 # virtual devices, kill mid-epoch, resume on N' — docs/recovery.md
 # "Elastic topology resume"); the slow marker is NOT excluded here
-chaos:
+chaos: chaos-cluster
 	$(PY) -m pytest tests/unit/test_fault_tolerance.py tests/unit/test_sentinel.py \
-	  tests/unit/test_elastic_reshard.py tests/unit/test_serving_fleet.py -q
+	  tests/unit/test_elastic_reshard.py tests/unit/test_serving_fleet.py \
+	  tests/unit/test_health_state.py tests/unit/test_cluster_health.py -q
+
+# wedge-one-rank / flip-one-bit scenarios on a real two-process world
+# under the world agent (docs/recovery.md "Cluster health & SDC
+# defense"); exits nonzero if any survivor hangs instead of aborting 15,
+# the world relaunches more than once, the digest probe misses the
+# corruption, or the resumed losses leave the reference trajectory
+chaos-cluster:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/chaos_cluster.py
 
 # serving-fleet kill scenario: three runs over one trace (in-process
 # reference, fleet baseline, fleet with a mid-decode SIGKILL) proving

@@ -1,0 +1,556 @@
+#!/usr/bin/env python
+"""Cluster-health chaos scenarios: wedge one process of a REAL
+two-process world, and silently corrupt a replicated parameter — then
+PROVE the detect -> coordinated-abort -> world-relaunch -> resume
+contract end to end (docs/recovery.md "Cluster health & SDC defense").
+
+Two scenarios, each compared against an uninterrupted single-process
+8-device reference run of the identical training program (the
+test_multihost parity recipe: same constant batches, so step-i loss is
+a pure function of the step-i parameters):
+
+1. **wedge** — pipeline training over pp=2 x dp=4, one JAX process per
+   stage, ``ppermute`` transport. At step K rank 0 SIGSTOPs itself
+   right after its checkpoint lands (``utils/fault_injection.py
+   stall_at_step`` semantics: every thread freezes, heartbeats
+   included). Rank 1 is parked inside a cross-process collective it
+   can never finish — only its out-of-band health plane can act.
+2. **sdc** — data-parallel training across both processes. At step F
+   rank 0 flips one low mantissa bit of a replicated weight
+   (``bitflip_at_step``): no NaN, no crash, loss moves ~1e-7 — only
+   the every-K-steps cross-host parameter digest can see it.
+
+Both worlds run under ``elasticity.elastic_agent.DSWorldAgent``, the
+supervisor this plane's exit contract is written against.
+
+Hard assertions (exit 1 on any failure):
+
+* the surviving / detecting workers exit with code 15
+  (``constants.PEER_LOSS_EXIT_CODE_DEFAULT``) — within the silence
+  budget in the wedge scenario, not after an indefinite hang;
+* the agent performs exactly ONE world-level relaunch per fault
+  (``world_relaunches == 1``) and the relaunched world finishes
+  cleanly (final rc 0);
+* the resumed run starts from the newest manifest-valid tag (wedge:
+  the step-K save; sdc: the last PRE-corruption save) and its losses
+  match the uninterrupted reference trajectory to rtol 1e-4;
+* sdc only: the digest probe catches the flip within K =
+  ``digest_every_k`` steps of the first corrupted step, and the abort
+  leaves a crc-valid flight-recorder blackbox whose event ring holds
+  the fatal ``health.sdc`` event (plus a swept run-level
+  crash-report.json).
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/chaos_cluster.py
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "chaos_cluster_results.json")
+
+# wedge scenario: save every step, SIGSTOP rank 0 after its step-3 save
+WEDGE_STEPS, WEDGE_FAULT = 6, 3
+# sdc scenario: flip fires on the dispatch AFTER step 5 (first corrupted
+# step is 6), probe every 2 steps, saves every 4 — the step-4 tag is the
+# newest save that predates the corruption, and the abort must land
+# before the step-8 save could persist corrupted weights
+# save cadence 5 with the flip armed at 5: the step-5 save commits just
+# before the corruption enters (step 6), and the next save (step 10) sits
+# a full probe-plus-abort window past detection, the "checkpoint cadence
+# >> detection latency" property real jobs rely on
+SDC_STEPS, SDC_FAULT, SDC_EVERY_K, SDC_SAVE_EVERY = 12, 5, 2, 5
+# generous CI budget on top of the plane's own silence schedule
+# (suspect 1.0s + down 3.0s); the claim is "bounded by the schedule,
+# not by a human noticing", so the bound just needs to be far below the
+# 600s a wedged collective would otherwise hang for
+ABORT_LATENCY_BUDGET_S = 12.0
+
+# Runs as every worker AND the single-process references; env-driven.
+WORKER = r'''
+import json, os, signal, sys, threading, time
+
+sys.path.insert(0, os.environ["CHAOS_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+# a wedged-beyond-recovery worker must not hang the bench forever: any
+# incarnation overrunning the deadline exits 99 (a frozen SIGSTOP
+# victim cannot fire this timer — the agent SIGKILLs it instead)
+_deadline = threading.Timer(
+    float(os.environ.get("CHAOS_DEADLINE_S", "420")), os._exit, args=(99,))
+_deadline.daemon = True
+_deadline.start()
+
+multi = int(os.environ.get("DS_TPU_NUM_PROCS", "1")) > 1
+if multi:
+    # rendezvous must precede ANY backend initialisation
+    from deepspeed_tpu.comm import comm
+    comm.init_distributed()
+
+import numpy as np
+import jax.numpy as jnp
+import flax.linen as nn
+import deepspeed_tpu
+
+CASE = os.environ["CHAOS_CASE"]                      # wedge | sdc
+TOTAL = int(os.environ["CHAOS_STEPS"])
+FAULT = int(os.environ["CHAOS_FAULT_STEP"])
+OUT = os.environ["CHAOS_OUT"]
+CKPT = os.environ.get("CHAOS_CKPT", "")
+SAVE_EVERY = int(os.environ.get("CHAOS_SAVE_EVERY", "1"))
+STEP_SLEEP = float(os.environ.get("CHAOS_STEP_SLEEP", "0"))
+incarnation = int(os.environ.get("DS_TPU_ELASTIC_RESTART", "0"))
+rank = jax.process_index()
+peers = [p for p in os.environ.get("CHAOS_HEALTH_PEERS", "").split(",") if p]
+
+HEALTH = {
+    # auto: on for the 2-process worlds, off for the 1-process reference
+    "enabled": "auto", "peers": peers, "beat_interval_s": 0.2,
+    "suspect_after_s": 1.0, "down_after_s": 3.0,
+    "digest_every_k": int(os.environ.get("CHAOS_DIGEST_EVERY_K", "0")),
+}
+
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x, y=None, deterministic=True):
+        x = nn.relu(nn.Dense(16, name="l0")(x))
+        x = nn.Dense(1, name="head")(x)
+        if y is None:
+            return x
+        return jnp.mean((x - y) ** 2)
+
+
+def _mlp_batches():
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 1).astype(np.float32)
+    x = rng.randn(16, 16).astype(np.float32)
+    batch = {"x": x, "y": (x @ w).astype(np.float32)}
+    while True:
+        yield batch
+
+
+def _token_batches(batch_size):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, size=(batch_size, 32)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    while True:
+        yield batch
+
+
+base = {
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+    "steps_per_print": 10 ** 9,
+}
+if CASE == "wedge":
+    from deepspeed_tpu.models.pipeline_gpt import gpt_pipeline
+    from deepspeed_tpu.models.transformer_lm import GPTConfig
+
+    cfg = dict(base, train_micro_batch_size_per_gpu=2,
+               gradient_accumulation_steps=2, gradient_clipping=1.0,
+               tpu={"mesh": {"pp": 2, "dp": 4},
+                    "pipeline": {"transport": "ppermute"},
+                    "cluster_health": HEALTH})
+    model = gpt_pipeline(
+        GPTConfig(vocab_size=128, n_positions=32, n_embd=32, n_layer=4,
+                  n_head=4, dtype=jnp.float32, param_dtype=jnp.float32,
+                  scan_layers=False),
+        num_stages=2)
+    it = _token_batches(8)
+elif CASE == "sdc":
+    cfg = dict(base, train_micro_batch_size_per_gpu=2,
+               telemetry={"enabled": True},
+               tpu={"cluster_health": HEALTH})
+    model, it = M(), _mlp_batches()
+else:
+    raise ValueError(CASE)
+
+engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+inject = multi and rank == 0 and incarnation == 0
+
+if CASE == "sdc":
+    # Pre-place every batch as a committed global array (metadata-only:
+    # each process slices its own rows) instead of handing numpy to the
+    # engine. A numpy batch makes jax.device_put run its cross-host
+    # value-equality probe -- a broadcast program with one independent
+    # gloo all-reduce PER LEAF, whose per-device ops the CPU transport
+    # can interleave differently on each rank (misframed-op abort,
+    # "op.preamble.length <= op.nbytes"). Real multihost input pipelines
+    # build global arrays exactly like this; _put_batch passes them
+    # through untouched.
+    _bsh = engine.topology.batch_sharding()
+
+    def _global_batches(gen):
+        for b in gen:
+            yield {k: jax.make_array_from_callback(
+                       v.shape, _bsh, lambda idx, v=v: v[idx])
+                   for k, v in b.items()}
+
+    it = _global_batches(it)
+
+resume_tag = os.environ.get("DS_TPU_LAST_VALID_TAG")
+if incarnation > 0 and resume_tag and CKPT:
+    engine.train_batch(it)  # init state templates; load overwrites them
+    engine.load_checkpoint(CKPT, tag=resume_tag)
+
+losses = {"_resume_tag": resume_tag if incarnation > 0 else None}
+loss_path = os.path.join(OUT, "losses-r%d-i%d.json" % (rank, incarnation))
+
+
+def _flush():
+    # atomic per step, so an os._exit(15) abort cannot tear the file
+    with open(loss_path + ".tmp", "w") as f:
+        json.dump(losses, f)
+    os.replace(loss_path + ".tmp", loss_path)
+
+
+def run_steps():
+    while engine.global_steps < TOTAL:
+        loss = float(engine.train_batch(it))
+        losses[str(engine.global_steps)] = loss
+        _flush()
+        if CKPT and engine.global_steps % SAVE_EVERY == 0:
+            if CASE == "wedge" or rank == 0:
+                # pipe: every rank owns a stage and must save it; dp:
+                # the replicated state is whole on rank 0
+                engine.save_checkpoint(CKPT)
+            if multi:
+                # barrier the save boundary, the standard multi-host
+                # checkpoint discipline: without it the non-saving rank
+                # queues several steps of collectives against the gloo
+                # pairs while rank 0 is off the collective stream for
+                # seconds, which the CPU transport answers with
+                # misframed-op aborts, not graceful stalls
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices(
+                    "chaos-save-%d" % engine.global_steps)
+        if CASE == "wedge" and inject and engine.global_steps == FAULT:
+            with open(os.path.join(OUT, "stall_marker.json"), "w") as f:
+                json.dump({"t": time.time(), "step": engine.global_steps}, f)
+            os.kill(os.getpid(), signal.SIGSTOP)
+        if STEP_SLEEP:
+            time.sleep(STEP_SLEEP)
+
+
+if CASE == "sdc" and inject:
+    from deepspeed_tpu.utils import fault_injection as fi
+
+    # fires on the first dispatch with global_steps >= FAULT, i.e. the
+    # corruption enters at step FAULT+1
+    with fi.bitflip_at_step(engine, step=FAULT, leaf="l0", bit=1):
+        run_steps()
+else:
+    run_steps()
+
+if engine.health_plane is not None:
+    # stop beating BEFORE the clean exit: a finished process going
+    # silent is indistinguishable from a dead one
+    engine.health_plane.stop()
+print("CHAOS_DONE rank=%d inc=%d" % (rank, incarnation))
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _ephemeral_floor():
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            return int(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return 32768
+
+
+def _health_ports(n):
+    """Reserve ``n`` health-plane ports BELOW the kernel ephemeral range.
+
+    The health peer list is fixed for the lifetime of the job and spans
+    every world incarnation, while gloo pair listeners and coordinator
+    client sockets get kernel-assigned ephemeral ports on every relaunch.
+    A port picked via ``bind(0)`` lives in that same ephemeral range, so
+    sooner or later a relaunched world's collective transport lands on a
+    health port and the next JSON beat arrives as garbage inside gloo's
+    framing (``op.preamble.length <= op.nbytes``) — C++ terminate,
+    SIGABRT, and a crash that looks nothing like its cause.  Ports under
+    the ephemeral floor are never auto-assigned by the kernel, which
+    removes the collision class entirely (docs/recovery.md "Cluster
+    health & SDC defense")."""
+    floor = _ephemeral_floor()
+    base = 20000 + (os.getpid() * 7) % 8000
+    ports = []
+    port = max(base, _health_ports.next_port)
+    while len(ports) < n:
+        if port >= floor:
+            raise RuntimeError("no free sub-ephemeral ports")
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("127.0.0.1", port))
+        except OSError:
+            pass
+        else:
+            ports.append(port)
+        finally:
+            s.close()
+        port += 1
+    _health_ports.next_port = port
+    return ports
+
+
+_health_ports.next_port = 0
+
+
+def _child_env(device_count, extra):
+    env = dict(os.environ)
+    base_flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (base_flags + " --xla_force_host_platform_"
+                        "device_count=%d" % device_count).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["CHAOS_REPO"] = REPO
+    for k in ("DS_TPU_COORDINATOR", "DS_TPU_PROC_ID", "DS_TPU_NUM_PROCS",
+              "DS_TPU_LAST_VALID_TAG", "DS_TPU_ELASTIC_RESTART",
+              "DS_TPU_TELEMETRY_DIR"):
+        env.pop(k, None)
+    env.update(extra)
+    return env
+
+
+def _reference(case, steps, out_dir, extra=None):
+    """Uninterrupted single-process 8-device run of the same program."""
+    os.makedirs(out_dir, exist_ok=True)
+    env = _child_env(8, dict({"CHAOS_CASE": case,
+                              "CHAOS_STEPS": str(steps),
+                              "CHAOS_FAULT_STEP": "-1",
+                              "CHAOS_OUT": out_dir,
+                              "CHAOS_CKPT": ""}, **(extra or {})))
+    proc = subprocess.run([sys.executable, "-c", WORKER], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True, timeout=600)
+    assert proc.returncode == 0, (
+        "reference run (%s) failed rc=%d:\n%s"
+        % (case, proc.returncode, proc.stdout))
+    with open(os.path.join(out_dir, "losses-r0-i0.json")) as f:
+        return json.load(f)
+
+
+def _load_losses(out_dir, rank, incarnation):
+    with open(os.path.join(
+            out_dir, "losses-r%d-i%d.json" % (rank, incarnation))) as f:
+        return json.load(f)
+
+
+def _assert_close(got, ref, steps, rtol, label):
+    for s in steps:
+        g, r = got[str(s)], ref[str(s)]
+        assert abs(g - r) <= rtol * abs(r) + 1e-7, (
+            "%s: step %d loss %.8f drifted from reference %.8f"
+            % (label, s, g, r))
+
+
+def _make_agent(extra_env, ckpt, telemetry_dir=None):
+    from deepspeed_tpu.elasticity.elastic_agent import DSWorldAgent
+
+    class RecordingAgent(DSWorldAgent):
+        """Per-incarnation exit codes + wall-clock, for the contract
+        assertions below."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.incarnations = []
+
+        def _supervise_once(self, world):
+            rc = super()._supervise_once(world)
+            self.incarnations.append({"rc": rc, "t_end": time.time()})
+            return rc
+
+    env = _child_env(4, extra_env)
+    return RecordingAgent(
+        [sys.executable, "-c", WORKER], {}, discover_world=lambda: 2,
+        max_restarts=2, backoff_s=0.2, jitter=0.0, ckpt_dir=ckpt,
+        telemetry_dir=telemetry_dir, env=env)
+
+
+def scenario_wedge(tmp):
+    """SIGSTOP one process of a pp=2 world mid-run."""
+    out = os.path.join(tmp, "wedge")
+    ckpt = os.path.join(out, "ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+    print("[wedge] reference run (1 process, 8 devices) ...")
+    ref = _reference("wedge", WEDGE_STEPS, os.path.join(out, "ref"))
+
+    peers = ",".join("127.0.0.1:%d" % p for p in _health_ports(2))
+    agent = _make_agent({
+        "CHAOS_CASE": "wedge", "CHAOS_STEPS": str(WEDGE_STEPS),
+        "CHAOS_FAULT_STEP": str(WEDGE_FAULT), "CHAOS_OUT": out,
+        "CHAOS_CKPT": ckpt, "CHAOS_SAVE_EVERY": "1",
+        "CHAOS_HEALTH_PEERS": peers,
+    }, ckpt)
+    print("[wedge] chaos world (2 processes, SIGSTOP rank 0 at step %d)"
+          " ..." % WEDGE_FAULT)
+    rc = agent.run()
+
+    assert rc == 0, "world agent final rc=%d (expected clean finish)" % rc
+    codes = [i["rc"] for i in agent.incarnations]
+    assert codes == [15, 0], (
+        "per-incarnation exit codes %r != [15, 0]: the survivor must "
+        "exit with the coordinated peer-loss code, then the relaunched "
+        "world must finish" % (codes,))
+    assert agent.world_relaunches == 1, agent.world_relaunches
+
+    # the survivor pulled the plug within the silence budget, not after
+    # an indefinite collective hang
+    with open(os.path.join(out, "stall_marker.json")) as f:
+        marker = json.load(f)
+    assert marker["step"] == WEDGE_FAULT, marker
+    latency = agent.incarnations[0]["t_end"] - marker["t"]
+    assert 0 < latency < ABORT_LATENCY_BUDGET_S, (
+        "survivor abort took %.1fs (budget %.1fs: suspect 1s + down 3s "
+        "+ teardown slack)" % (latency, ABORT_LATENCY_BUDGET_S))
+
+    # resumed exactly from the step-K tag, and the post-resume losses
+    # sit on the uninterrupted reference trajectory
+    resumed = _load_losses(out, 1, 1)
+    assert resumed["_resume_tag"] == "global_step%d" % WEDGE_FAULT, resumed
+    got_steps = sorted(int(k) for k in resumed if not k.startswith("_"))
+    assert got_steps == list(range(WEDGE_FAULT + 1, WEDGE_STEPS + 1)), (
+        got_steps)
+    _assert_close(resumed, ref, got_steps, 1e-4, "wedge resume")
+    # pre-fault steps of the first incarnation were already on-trajectory
+    first = _load_losses(out, 1, 0)
+    _assert_close(first, ref, range(1, WEDGE_FAULT + 1), 1e-4,
+                  "wedge pre-fault")
+    print("[wedge] OK: survivor exit 15 in %.1fs, 1 world relaunch, "
+          "resume from global_step%d on-trajectory" % (latency, WEDGE_FAULT))
+    return {"abort_latency_s": round(latency, 2),
+            "world_relaunches": agent.world_relaunches,
+            "resume_tag": resumed["_resume_tag"]}
+
+
+def scenario_sdc(tmp):
+    """Flip one mantissa bit of a replicated weight on one process."""
+    from deepspeed_tpu.telemetry import crash_report
+
+    out = os.path.join(tmp, "sdc")
+    ckpt = os.path.join(out, "ckpt")
+    tel = os.path.join(out, "telemetry")
+    os.makedirs(ckpt, exist_ok=True)
+    os.makedirs(tel, exist_ok=True)
+    print("[sdc] reference run (1 process, 8 devices) ...")
+    ref = _reference("sdc", SDC_STEPS, os.path.join(out, "ref"))
+
+    peers = ",".join("127.0.0.1:%d" % p for p in _health_ports(2))
+    agent = _make_agent({
+        "CHAOS_CASE": "sdc", "CHAOS_STEPS": str(SDC_STEPS),
+        "CHAOS_FAULT_STEP": str(SDC_FAULT), "CHAOS_OUT": out,
+        "CHAOS_CKPT": ckpt, "CHAOS_SAVE_EVERY": str(SDC_SAVE_EVERY),
+        "CHAOS_DIGEST_EVERY_K": str(SDC_EVERY_K),
+        # slower than a beat interval, so digests cross-check (and the
+        # abort lands) well before the next post-corruption save
+        "CHAOS_STEP_SLEEP": "0.75",
+        "CHAOS_HEALTH_PEERS": peers,
+    }, ckpt, telemetry_dir=tel)
+    print("[sdc] chaos world (2 processes, bit flip on rank 0 after "
+          "step %d, digest every %d) ..." % (SDC_FAULT, SDC_EVERY_K))
+    rc = agent.run()
+
+    assert rc == 0, "world agent final rc=%d (expected clean finish)" % rc
+    codes = [i["rc"] for i in agent.incarnations]
+    assert codes == [15, 0], (
+        "per-incarnation exit codes %r != [15, 0]: an SDC digest "
+        "mismatch must coordinate an exit-15 abort" % (codes,))
+    assert agent.world_relaunches == 1, agent.world_relaunches
+
+    # the detecting rank dumped a crc-valid blackbox whose event ring
+    # pins the mismatch to a digest step within K of the corruption
+    dumps = [f for f in os.listdir(tel) if f.startswith("blackbox-rank")]
+    assert dumps, "no blackbox dump under %s" % tel
+    sdc_events = []
+    for name in dumps:
+        with open(os.path.join(tel, name)) as f:
+            payload = json.load(f)
+        assert crash_report.verify_blackbox(payload), (
+            "blackbox %s failed its crc check" % name)
+        assert payload["reason"] == "cluster_health_sdc", payload["reason"]
+        assert payload["exit_code"] == 15, payload["exit_code"]
+        sdc_events += [e for e in payload["events"]
+                       if e.get("kind") == "health.sdc"]
+    assert sdc_events, "no health.sdc event in any blackbox ring"
+    digest_step = int(sdc_events[0]["digest_step"])
+    # corruption enters at step FAULT+1; the probe must see it within K
+    assert SDC_FAULT < digest_step <= SDC_FAULT + SDC_EVERY_K, (
+        "digest mismatch at step %d, outside (%d, %d]"
+        % (digest_step, SDC_FAULT, SDC_FAULT + SDC_EVERY_K))
+    assert os.path.exists(os.path.join(tel, "crash-report.json"))
+
+    # the relaunch rolled back to the last PRE-corruption tag (the
+    # corrupted steps were never saved) and re-trained on-trajectory
+    resumed = _load_losses(out, 1, 1)
+    assert resumed["_resume_tag"] == "global_step%d" % SDC_SAVE_EVERY, (
+        resumed)
+    got_steps = sorted(int(k) for k in resumed if not k.startswith("_"))
+    assert got_steps == list(range(SDC_SAVE_EVERY + 1, SDC_STEPS + 1)), (
+        got_steps)
+    _assert_close(resumed, ref, got_steps, 1e-4, "sdc rollback")
+    first = _load_losses(out, 1, 0)
+    _assert_close(first, ref, range(1, SDC_FAULT + 1), 1e-4,
+                  "sdc pre-fault")
+    print("[sdc] OK: mismatch caught at digest step %d (flip after step "
+          "%d), crc-valid blackbox, rollback to global_step%d "
+          "on-trajectory" % (digest_step, SDC_FAULT, SDC_SAVE_EVERY))
+    return {"digest_step": digest_step,
+            "world_relaunches": agent.world_relaunches,
+            "resume_tag": resumed["_resume_tag"],
+            "blackbox_ranks": sorted(dumps)}
+
+
+def main(argv=None):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    # optional scenario filter (debug aid): `chaos_cluster.py sdc` runs
+    # one scenario without writing the committed results artifact
+    only = (argv or sys.argv[1:] or ["all"])[0]
+    assert only in ("all", "wedge", "sdc"), only
+
+    t0 = time.time()
+    results = {}
+    with tempfile.TemporaryDirectory(prefix="chaos-cluster-") as tmp:
+        if only in ("all", "wedge"):
+            results["wedge"] = dict(scenario_wedge(tmp), steps=WEDGE_STEPS,
+                                    fault_step=WEDGE_FAULT)
+        if only in ("all", "sdc"):
+            results["sdc"] = dict(scenario_sdc(tmp), steps=SDC_STEPS,
+                                  fault_step=SDC_FAULT,
+                                  digest_every_k=SDC_EVERY_K,
+                                  save_every=SDC_SAVE_EVERY)
+    results["wall_s"] = round(time.time() - t0, 1)
+    if only == "all":
+        with open(RESULTS, "w") as f:
+            json.dump(results, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print("chaos-cluster: all scenarios green (%.0fs) -> %s"
+              % (results["wall_s"], RESULTS))
+    else:
+        print("chaos-cluster: scenario %r green (%.0fs; artifact not "
+              "written)" % (only, results["wall_s"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -188,6 +188,19 @@ class DSElasticAgent:
     def _launch(self, world: int) -> subprocess.Popen:
         return subprocess.Popen(self.cmd, env=self._worker_env(world))
 
+    def _supervise_once(self, world: int) -> int:
+        """Launch one incarnation and block until it exits (the loop
+        body of :meth:`run`; :class:`DSWorldAgent` overrides it to
+        supervise a whole multi-process world as one unit)."""
+        self._proc = self._launch(world)
+        return self._proc.wait()
+
+    def _interrupt(self) -> None:
+        """KeyboardInterrupt path: pass the SIGTERM along and reap."""
+        if self._proc is not None:
+            self._proc.send_signal(signal.SIGTERM)
+            self._proc.wait()
+
     def _discover(self) -> int:
         world = self.discover_world()
         if world < 1:
@@ -226,17 +239,27 @@ class DSElasticAgent:
         ``crash_loop_threshold`` per ``crash_loop_window_s``."""
         while True:
             world = self._discover()
-            self._proc = self._launch(world)
             started = time.monotonic()
             try:
-                rc = self._proc.wait()
+                rc = self._supervise_once(world)
             except KeyboardInterrupt:
-                self._proc.send_signal(signal.SIGTERM)
-                self._proc.wait()
+                self._interrupt()
                 return 1
             if rc == 0:
                 return 0
             self._sweep_crash_report(rc)
+            if rc == ds_constants.PEER_LOSS_EXIT_CODE_DEFAULT:
+                # the cluster health plane's coordinated abort: every
+                # survivor exits 15 inside the silence budget, so THIS
+                # failure is one world-level event, not a local crash.
+                # Restartable — the relaunch resumes from the newest
+                # manifest-valid tag; a permanently-gone peer changes
+                # the discovered world below and takes the topology-
+                # event path (immediate relaunch, no budget burned).
+                meaning, _ = ds_constants.EXIT_CODE_MEANINGS[rc]
+                logger.warning(
+                    f"worker exited with code {rc} ({meaning}): "
+                    f"relaunching the world together")
             if rc in self.divergence_exit_codes:
                 logger.error(
                     f"worker exited with divergence code {rc}: training "
@@ -319,6 +342,130 @@ class DSElasticAgent:
             f"rank(s), reasons={report['reasons']}, last step "
             f"{report['last_step_min']}..{report['last_step_max']}, "
             f"first fatal rank {report['first_fatal_rank']}")
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    """Ask the kernel for a currently-free TCP port (the standard
+    bind-to-0 trick). Used to mint a fresh coordinator port per world
+    incarnation so a relaunch never races the dying rendezvous of the
+    previous one in TIME_WAIT."""
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class DSWorldAgent(DSElasticAgent):
+    """Supervise ALL processes of one training world as a single unit.
+
+    The per-host :class:`DSElasticAgent` cannot express the cluster
+    health plane's contract (docs/recovery.md "Cluster health & SDC
+    defense"): when one process of a multi-process world is lost — or
+    wedged, so its peers abort with
+    :data:`constants.PEER_LOSS_EXIT_CODE_DEFAULT` — the WORLD must
+    relaunch together. ``jax.distributed`` rendezvous needs every
+    process present; a survivor restarted alone would just park in the
+    coordinator barrier. This agent therefore:
+
+    * launches ``world`` workers, each with its own ``DS_TPU_PROC_ID``
+      and a shared freshly-minted ``DS_TPU_COORDINATOR`` port (a new
+      port per incarnation, so relaunch N+1 cannot collide with the
+      half-dead rendezvous of incarnation N);
+    * waits for the FIRST abnormal exit and then SIGKILLs the remaining
+      workers — SIGKILL, not SIGTERM, because a SIGSTOP-wedged or
+      collective-hung process cannot honor a catchable signal;
+    * feeds that single exit code into the base class's restart policy,
+      so one coordinated failure costs exactly ONE restart (and one
+      ``world_relaunches`` tick, which the chaos bench asserts on).
+    """
+
+    def __init__(self, cmd: List[str], ds_config: Dict,
+                 coordinator_host: str = "127.0.0.1",
+                 port_factory: Optional[Callable[[], int]] = None,
+                 **kwargs):
+        super().__init__(cmd, ds_config, **kwargs)
+        self.coordinator_host = coordinator_host
+        self._port_factory = port_factory or (
+            lambda: _free_port(self.coordinator_host))
+        self._procs: List[subprocess.Popen] = []
+        self._worlds_launched = 0
+        # world-level relaunches performed (== launches - 1): the chaos
+        # bench asserts a coordinated exit-15 costs exactly ONE of these
+        self.world_relaunches = 0
+
+    # ------------------------------------------------------------------
+    def _rank_env(self, world: int, rank: int, port: int) -> Dict[str, str]:
+        env = self._worker_env(world)
+        env["DS_TPU_PROC_ID"] = str(rank)
+        env["DS_TPU_COORDINATOR"] = f"{self.coordinator_host}:{port}"
+        return env
+
+    def _supervise_once(self, world: int) -> int:
+        port = self._port_factory()
+        self._worlds_launched += 1
+        if self._worlds_launched > 1:
+            self.world_relaunches += 1
+        logger.info(
+            f"world agent: launching world of {world} process(es) "
+            f"(incarnation {self._worlds_launched}, coordinator "
+            f"{self.coordinator_host}:{port})")
+        self._procs = [
+            subprocess.Popen(self.cmd, env=self._rank_env(world, r, port))
+            for r in range(world)
+        ]
+        try:
+            return self._wait_world()
+        finally:
+            self._reap()
+
+    def _wait_world(self) -> int:
+        """Block until the world resolves: 0 when every worker exited
+        cleanly, else the exit code of the FIRST abnormal worker (the
+        caller SIGKILLs the rest — they are either about to exit with
+        the same coordinated code or wedged beyond signaling)."""
+        pending = set(range(len(self._procs)))
+        while pending:
+            progressed = False
+            for i in sorted(pending):
+                rc = self._procs[i].poll()
+                if rc is None:
+                    continue
+                pending.discard(i)
+                progressed = True
+                if rc != 0:
+                    logger.warning(
+                        f"world agent: rank {i} exited rc={rc}; tearing "
+                        f"down the remaining {len(pending)} worker(s)")
+                    return rc
+            if pending and not progressed:
+                self._sleep(0.05)
+        return 0
+
+    def _reap(self) -> None:
+        """SIGKILL and reap every still-running worker. SIGKILL cannot
+        be blocked and — unlike SIGTERM — acts on a SIGSTOPed process
+        without a prior SIGCONT, which is exactly the wedged-peer case
+        this agent exists for."""
+        for proc in self._procs:
+            if proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:  # already gone
+                    pass
+        for proc in self._procs:
+            try:
+                proc.wait(timeout=30)
+            except Exception:  # pragma: no cover - kernel-level wedge
+                logger.error(
+                    f"world agent: worker pid {proc.pid} did not reap "
+                    f"after SIGKILL")
+
+    def _interrupt(self) -> None:
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        self._reap()
 
 
 def main(argv=None) -> int:

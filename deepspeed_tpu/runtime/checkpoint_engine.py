@@ -52,6 +52,14 @@ class CheckpointEngine:
     def commit(self, tag: str) -> bool:
         return True
 
+    def pinned_tags(self) -> set:
+        """Tags the retention GC must NOT delete right now. Synchronous
+        engines have nothing to pin (their writes are durable before
+        ``save`` returns); the async engine pins every tag with an
+        in-flight write so ``keep_n`` can never delete a directory a
+        writer thread is still filling."""
+        return set()
+
     def set_topology_metadata(self, metadata: Optional[Dict[str, Any]]):
         """Attach a topology block (world size, zero stage, axis sizes,
         per-leaf partition specs) to every manifest the next ``commit``
@@ -151,8 +159,20 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._lock = threading.Lock()
         self._errors: list = []
         self._pending: list = []
+        # tag -> number of in-flight writes into that tag's directory.
+        # This is what pinned_tags() reads; _pending alone cannot serve,
+        # because wait() POPS it — a retention GC racing a concurrent
+        # wait() would see an empty pending list while writes are still
+        # on the queue and delete the very tag being written.
+        self._inflight_tags: Dict[str, int] = {}
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
+
+    @staticmethod
+    def _tag_of(path: str) -> str:
+        """Checkpoint files live at ``<save_dir>/<tag>/<file>``: the
+        tag is the parent directory's basename."""
+        return os.path.basename(os.path.dirname(path))
 
     def _drain(self):
         while True:
@@ -169,6 +189,15 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 with self._lock:
                     self._errors.append((path, e))
             finally:
+                # unpin BEFORE signalling done: once a waiter wakes the
+                # GC may run, and it must already see the updated pins
+                tag = self._tag_of(path)
+                with self._lock:
+                    count = self._inflight_tags.get(tag, 0) - 1
+                    if count > 0:
+                        self._inflight_tags[tag] = count
+                    else:
+                        self._inflight_tags.pop(tag, None)
                 done.set()
 
     def save(self, state_dict: Dict[str, Any], path: str):
@@ -177,9 +206,15 @@ class AsyncCheckpointEngine(CheckpointEngine):
         # accumulated and surfaced together at commit()/load()
         host_state = _to_host(state_dict)  # consistent snapshot, blocking
         done = threading.Event()
+        tag = self._tag_of(path)
         with self._lock:
             self._pending.append(done)
+            self._inflight_tags[tag] = self._inflight_tags.get(tag, 0) + 1
         self._queue.put((host_state, path, done))
+
+    def pinned_tags(self) -> set:
+        with self._lock:
+            return set(self._inflight_tags)
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
         self.wait()  # never read a file a pending write may still replace

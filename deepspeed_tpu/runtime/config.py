@@ -589,6 +589,99 @@ class StepAutotuneConfig(ConfigModel):
 
 
 @dataclass
+class ClusterHealthConfig(ConfigModel):
+    """Cluster health plane (``runtime/health.py``; docs/recovery.md
+    "Cluster health & SDC defense"). An out-of-band TCP heartbeat mesh
+    between training processes — daemon threads, never through XLA
+    collectives, so it stays live while the main thread is wedged inside
+    one. Peers are tracked with the healthy→suspect→down silence
+    schedule shared with the serving fleet (utils/health_state.py); a
+    peer declared down mid-step makes every survivor abort with
+    ``exit_code`` (one world-level failure for the elastic agent instead
+    of N staggered hang timeouts); per-host step-time skew emits
+    ``health.straggler``; and every ``digest_every_k`` steps an SDC probe
+    digests the fully-replicated param leaves and cross-checks the
+    digests over the mesh."""
+
+    # "auto" = off single-process, on when jax.process_count() > 1; also
+    # accepts plain booleans from JSON
+    enabled: Any = "auto"
+    host: str = "127.0.0.1"      # address this rank's beat server binds
+    port_base: int = 29700       # rank r listens on port_base + r
+    peers: List[str] = field(default_factory=list)  # ["host:port", ...]
+    beat_interval_s: float = 0.5
+    suspect_after_s: float = 2.0
+    down_after_s: float = 6.0
+    recover_probes: int = 2
+    abort_on_peer_loss: bool = True
+    exit_code: int = C.PEER_LOSS_EXIT_CODE_DEFAULT
+    # SDC parameter-digest probe cadence in optimizer steps (0 disables)
+    digest_every_k: int = 0
+    # "abort": coordinated exit_code abort (the agent relaunches the
+    # world from the newest manifest-valid tag); "rollback": flag the
+    # mismatch for the engine, which routes through the sentinel's
+    # in-process rollback at the next step boundary
+    sdc_action: str = "abort"
+    # straggler detection: own step-time EWMA vs the fleet median
+    straggler_ratio: float = 1.5       # <=0 disables
+    straggler_min_peers: int = 2       # ewma samples needed before judging
+    ewma_alpha: float = 0.2
+    # peer step counters further apart than this emit health.desync
+    step_skew_threshold: int = 10      # <=0 disables
+
+    def __post_init__validate__(self):
+        if self.enabled not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.enabled must be true/false/'auto', "
+                f"got {self.enabled!r}")
+        if self.beat_interval_s <= 0:
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.beat_interval_s must be > 0, got "
+                f"{self.beat_interval_s}")
+        if not 0 < self.suspect_after_s < self.down_after_s:
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health needs 0 < suspect_after_s < "
+                f"down_after_s, got {self.suspect_after_s} / "
+                f"{self.down_after_s}")
+        if self.beat_interval_s >= self.suspect_after_s:
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.beat_interval_s must be < "
+                "suspect_after_s (a healthy peer must beat faster than "
+                f"the schedule suspects it), got {self.beat_interval_s} "
+                f">= {self.suspect_after_s}")
+        if self.recover_probes < 1:
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.recover_probes must be >= 1, got "
+                f"{self.recover_probes}")
+        if not (1 <= int(self.exit_code) <= 255):
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.exit_code must be in [1, 255], got "
+                f"{self.exit_code}")
+        if self.digest_every_k < 0:
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.digest_every_k must be >= 0 "
+                f"(0 disables), got {self.digest_every_k}")
+        if self.sdc_action not in ("abort", "rollback"):
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.sdc_action must be 'abort' or "
+                f"'rollback', got {self.sdc_action!r}")
+        if not 0 < self.ewma_alpha <= 1:
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.ewma_alpha must be in (0, 1], got "
+                f"{self.ewma_alpha}")
+        if not (1 <= self.port_base <= 65535):
+            raise DeepSpeedConfigError(
+                "tpu.cluster_health.port_base must be a valid port, got "
+                f"{self.port_base}")
+
+    def resolve_enabled(self, process_count: int) -> bool:
+        """Auto-on exactly when there is a peer to watch."""
+        if self.enabled == "auto":
+            return int(process_count) > 1
+        return bool(self.enabled)
+
+
+@dataclass
 class TpuConfig(ConfigModel):
     mesh: Dict[str, Any] = field(default_factory=dict)
     remat: str = "none"  # none | full | selective (dots_saveable)
@@ -609,10 +702,16 @@ class TpuConfig(ConfigModel):
     step_autotune: Dict[str, Any] = field(default_factory=dict)
     # pipeline stage-to-stage transport — see TpuPipelineConfig
     pipeline: Dict[str, Any] = field(default_factory=dict)
+    # out-of-band heartbeat mesh + SDC probes — see ClusterHealthConfig
+    cluster_health: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def mesh_config(self) -> MeshConfig:
         return MeshConfig.from_dict(self.mesh)
+
+    @property
+    def cluster_health_config(self) -> ClusterHealthConfig:
+        return ClusterHealthConfig.from_dict(self.cluster_health)
 
     @property
     def pipeline_config(self) -> "TpuPipelineConfig":

@@ -134,12 +134,36 @@ GRACEFUL_SHUTDOWN = "graceful_shutdown"
 SENTINEL = "sentinel"
 SENTINEL_ENABLED = "enabled"
 SENTINEL_ENABLED_DEFAULT = False
+# ---------------------------------------------------------------------
+# Worker exit-code contract (docs/recovery.md). The elastic agent keys
+# its restart policy off these, so every sanctioned abnormal exit in
+# sentinel.py / engine.py / health.py must come from HERE — a literal 13
+# in one module and a drifted constant in another silently turns a
+# terminal divergence into a restart loop (or vice versa).
+#
 # distinct from any shell/signal convention: "diverged, restarting will
 # replay the same failure" vs "crashed, restart is the fix"
 DIVERGENCE_EXIT_CODE_DEFAULT = 13
 # the hang-watchdog abort code: a hang IS worth restarting (transient
 # wedged collective), so it must differ from the divergence code
 SENTINEL_HANG_EXIT_CODE_DEFAULT = 14
+# the cluster health plane's coordinated world abort: a peer went silent
+# mid-step (preempted / wedged host) or an SDC digest cross-check
+# mismatched. Every survivor exits with THIS code inside the silence
+# budget, so the agent sees one world-level failure (restartable — the
+# relaunch resumes from the newest manifest-valid tag) instead of N
+# staggered hang timeouts.
+PEER_LOSS_EXIT_CODE_DEFAULT = 15
+# what each sanctioned code means and whether the agent may restart into
+# it (the agent logs this; tests pin the contract)
+EXIT_CODE_MEANINGS = {
+    DIVERGENCE_EXIT_CODE_DEFAULT:
+        ("divergence past the rollback budget", False),
+    SENTINEL_HANG_EXIT_CODE_DEFAULT:
+        ("hang watchdog abort", True),
+    PEER_LOSS_EXIT_CODE_DEFAULT:
+        ("cluster health plane: peer loss / SDC coordinated abort", True),
+}
 
 # Elastic topology resume (docs/recovery.md "Elastic topology resume"):
 # on a restart where the discovered device count changed, the agent

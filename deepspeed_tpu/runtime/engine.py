@@ -519,6 +519,25 @@ class DeepSpeedEngine:
                     self.flight_recorder,
                     signals=tuple(tcfg.dump_signals))
 
+        # cluster health plane (docs/recovery.md "Cluster health & SDC
+        # defense"): out-of-band TCP heartbeats between processes, a
+        # coordinated exit-15 abort when a peer goes silent mid-step,
+        # straggler skew telemetry, and the every-K-steps SDC param
+        # digest. Auto-on exactly when there is a peer to watch
+        # (process_count > 1); built AFTER the flight recorder so the
+        # abort path can dump a blackbox.
+        self.health_plane = None
+        self._health_emitted = None
+        self._health_cfg = config.tpu.cluster_health_config
+        if self._health_cfg.resolve_enabled(jax.process_count()):
+            from deepspeed_tpu.runtime.health import ClusterHealthPlane
+
+            self.health_plane = ClusterHealthPlane(
+                jax.process_index(), jax.process_count(), self._health_cfg,
+                watchdog_probe=self._watchdog_armed,
+                on_abort=self._on_health_abort)
+            self.health_plane.start()
+
         # module-level activation checkpointing (reference engine.py:818
         # _configure_checkpointing): models that call
         # activation_checkpointing.checkpoint() pick up this policy
@@ -1986,6 +2005,8 @@ class DeepSpeedEngine:
         if self.sentinel is not None:
             with self._prof_phase("sentinel"):
                 self._sentinel_observe(update_skipped, host_loss)
+        if self.health_plane is not None:
+            self._health_step_hook()
         if self._preempt_signum is not None:
             self._graceful_shutdown()
 
@@ -2256,6 +2277,11 @@ class DeepSpeedEngine:
         if cfg.exit_after_save:
             if self._watchdog is not None:
                 self._watchdog.stop()
+            if self.health_plane is not None:
+                # a preemption grace exit is sanctioned: our own plane
+                # must not declare still-saving peers down and turn the
+                # clean exit into a coordinated 15
+                self.health_plane.stop()
             if self.monitor is not None:
                 # flush/close TB, wandb and CSV before the process dies
                 self.monitor.close()
@@ -2360,6 +2386,10 @@ class DeepSpeedEngine:
         self._emit_sentinel_events()
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self.health_plane is not None:
+            # divergence is terminal for the whole run: stop beating so
+            # peers see clean silence, not a half-alive zombie
+            self.health_plane.stop()
         logger.error("sentinel: training diverged: %s", reason)
         err = DivergenceError(
             f"training diverged: {reason}. Workers should exit with code "
@@ -2406,6 +2436,86 @@ class DeepSpeedEngine:
         self.monitor.write_events(
             counter_events("Sentinel", counters, self.global_steps))
         self._sentinel_emitted = counters
+
+    # ------------------------------------------------------------------
+    # cluster health plane (docs/recovery.md "Cluster health & SDC
+    # defense"): the engine side of runtime/health.py — step/digest
+    # feed, Health/* export, blackbox-then-abort, SDC rollback routing
+    # ------------------------------------------------------------------
+    def _watchdog_armed(self) -> bool:
+        """Beat payload probe: is this host currently mid-step? The
+        survivors' beats carrying ``watchdog_armed=True`` while a peer is
+        silent is the shared diagnosis ("everyone else is parked in the
+        collective") no single-process watchdog can produce."""
+        wd = self._watchdog
+        return wd is not None and wd.armed
+
+    def _health_step_hook(self):
+        """Step-boundary feed for the health plane: advance the beat's
+        step counter + step-time EWMA, run the every-K SDC digest probe,
+        and route a pending mismatch (``sdc_action: rollback``) through
+        the sentinel's rollback path."""
+        plane = self.health_plane
+        plane.notify_step(self.global_steps)
+        k = self._health_cfg.digest_every_k
+        if k > 0 and self.global_steps % k == 0:
+            from deepspeed_tpu.runtime.health import param_digest
+
+            with self._prof_phase("health_digest"):
+                plane.submit_digest(self.global_steps,
+                                    param_digest(self._params))
+        fault = plane.take_sdc_fault()
+        if fault is not None:
+            reason = (f"SDC digest mismatch vs peer {fault['peer']} at "
+                      f"step {fault['digest_step']} "
+                      f"(ours={fault['ours']:#010x} "
+                      f"theirs={fault['theirs']:#010x})")
+            if self.flight_recorder is not None:
+                # the mismatch evidence must survive even if the rollback
+                # below fails and escalates
+                self.flight_recorder.dump(
+                    "sdc", exit_code=self._health_cfg.exit_code)
+            if self.sentinel is not None \
+                    and self._config.sentinel.rollback_dir:
+                logger.error("cluster health: %s — rolling back", reason)
+                self._sentinel_rollback(reason)
+            else:
+                # no in-process rollback target: fall back to the
+                # coordinated abort so the agent relaunches the world
+                # from the newest manifest-valid tag
+                logger.error(
+                    "cluster health: %s — no sentinel rollback path "
+                    "(sentinel.enabled + sentinel.rollback_dir needed "
+                    "for sdc_action=rollback); aborting instead", reason)
+                plane.abort("sdc", **fault)
+        self._emit_health_events()
+
+    def _on_health_abort(self, reason, detail):
+        """ClusterHealthPlane ``on_abort``: blackbox first (the abort is
+        ``os._exit``, which skips atexit — the _on_watchdog_fire
+        pattern), so the relaunched world has forensics for WHY every
+        survivor exited 15 together."""
+        self._publish_telemetry(
+            "health.abort_dump", severity="fatal", reason=reason, **detail)
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump(
+                f"cluster_health_{reason}",
+                exit_code=self._health_cfg.exit_code)
+
+    def _emit_health_events(self):
+        """Export the plane counters as ``Health/*`` monitor events
+        whenever they changed (the _emit_sentinel_events pattern)."""
+        if (self.health_plane is None or self.monitor is None
+                or not getattr(self.monitor, "enabled", False)):
+            return
+        counters = self.health_plane.counters()
+        if counters == self._health_emitted:
+            return
+        from deepspeed_tpu.monitor.monitor import counter_events
+
+        self.monitor.write_events(
+            counter_events("Health", counters, self.global_steps))
+        self._health_emitted = counters
 
     # ------------------------------------------------------------------
     # checkpoint (reference engine.py:2545 load / :2889 save)
@@ -2565,11 +2675,16 @@ class DeepSpeedEngine:
     def _gc_checkpoints(self, save_dir):
         """Retention policy ``checkpoint.keep_n``: keep the newest N valid
         tags; never delete the tag the ``latest`` pointer names (a GC race
-        must not take down the reference recovery path)."""
+        must not take down the reference recovery path), nor any tag the
+        async engine still has writes in flight for — a concurrent
+        ``wait()`` can drain the pending list while files are mid-write,
+        and deleting such a tag would tear the checkpoint it is in the
+        middle of persisting."""
         keep_n = self._config.checkpoint_keep_n
         if keep_n <= 0:
             return
         protected = {ckpt_manifest.read_latest(save_dir)} - {None}
+        protected |= self.checkpoint_engine.pinned_tags()
         tags = ckpt_manifest.find_valid_tags(save_dir, check_data=False)
         for tag in tags[keep_n:]:
             if tag in protected:

@@ -1,0 +1,584 @@
+"""Cluster health plane: out-of-band peer liveness, coordinated abort,
+straggler detection, and SDC parameter-digest probes (docs/recovery.md
+"Cluster health & SDC defense").
+
+PR 19 made multi-process training real, and with it a failure class no
+single-process defense can see: one stalled, preempted, or
+silently-corrupted peer leaves every OTHER process blocked inside an XLA
+collective. The hang watchdog (``runtime/sentinel.py``) eventually fires
+on each survivor independently — N staggered timeouts, no shared
+diagnosis, N uncoordinated restarts. This module is the shared
+diagnosis:
+
+* :class:`ClusterHealthPlane` runs an **out-of-band TCP heartbeat
+  mesh** between the training processes. Everything lives on daemon
+  threads and plain sockets — never through XLA collectives — so the
+  plane stays live while the main thread is wedged inside one (the same
+  reasoning that makes the hang watchdog a daemon thread with
+  ``os._exit``: a hung collective cannot be unwound from another
+  thread).
+* Each beat carries ``(rank, step, watchdog_armed, step_time_ewma,
+  param_digest?)``. Peers are tracked with the healthy→suspect→down
+  silence schedule shared with the serving fleet
+  (``utils/health_state.SilenceSchedule`` — extracted from
+  ``serving/fleet.FleetHealth``).
+* A peer declared **down** mid-step makes every survivor perform a
+  coordinated abort with ``PEER_LOSS_EXIT_CODE_DEFAULT`` (15): the
+  elastic agent sees ONE world-level failure inside the silence budget
+  and relaunches the world together from the newest manifest-valid tag
+  (``elasticity/elastic_agent.py``; a permanently-gone peer routes
+  through the agent's topology-event path).
+* Rolling per-host **step-time skew** vs. the fleet median emits
+  ``health.straggler`` (the per-host skew sensitivity of pod-scale runs;
+  "Scale MLPerf-0.6 models on Google TPU-v3 Pods").
+* Every K steps an **SDC probe** (:func:`param_digest`) digests the
+  locally-addressable bits of the fully-replicated param leaves and the
+  digests are cross-checked over the heartbeat mesh — replicas must be
+  bit-identical, so any divergence is silent data corruption on some
+  host. A mismatch dumps the flight-recorder blackbox and routes to the
+  sentinel's rollback path (in-process, or via abort + relaunch from the
+  newest manifest-valid tag — ``tpu.cluster_health.sdc_action``).
+
+The plane is transport + policy only: no jax import at module scope (the
+digest helpers import it lazily), so supervisors and tests can import it
+as cheaply as ``sentinel.py``. ``clock`` / ``abort_fn`` / ``poll_once``
+/ ``send_beats`` are the same test seams ``HangWatchdog`` exposes.
+"""
+
+import json
+import os
+import socket
+import statistics
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.telemetry.bus import (
+    KIND_HEALTH_ABORT,
+    KIND_HEALTH_DESYNC,
+    KIND_HEALTH_PEER_DOWN,
+    KIND_HEALTH_PEER_UP,
+    KIND_HEALTH_SDC,
+    KIND_HEALTH_STRAGGLER,
+    telemetry_bus,
+)
+from deepspeed_tpu.utils.health_state import (
+    DOWN,
+    HEALTHY,
+    RECOVERING,
+    HealthConfig,
+    SilenceSchedule,
+)
+from deepspeed_tpu.utils.logging import logger
+
+# how many of our own digests we keep for cross-checking against beats
+# that arrive late (a peer's digest for step S may land after we already
+# probed S+K)
+_DIGEST_HISTORY = 32
+
+
+def _parse_peer(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+class ClusterHealthPlane:
+    """Out-of-band liveness + SDC cross-check for one training process.
+
+    Parameters
+    ----------
+    rank / world_size:
+        this process's index and the process count (NOT device counts —
+        the mesh is between host processes).
+    config:
+        a ``ClusterHealthConfig`` (``runtime/config.py``) or anything
+        with the same fields.
+    watchdog_probe:
+        callable -> bool; sampled into each beat as ``watchdog_armed``
+        so a surviving operator can see WHICH hosts were mid-step when
+        a peer vanished (the shared diagnosis the N independent
+        watchdogs cannot produce).
+    on_abort:
+        called with ``(reason, detail_dict)`` right before ``abort_fn``
+        — the engine dumps its flight-recorder blackbox here (an
+        ``os._exit`` abort skips atexit, same as the hang watchdog).
+    clock / abort_fn:
+        test seams; defaults ``time.monotonic`` / ``os._exit``.
+    """
+
+    def __init__(self, rank: int, world_size: int, config,
+                 watchdog_probe: Optional[Callable[[], bool]] = None,
+                 on_abort: Optional[Callable[[str, Dict[str, Any]], None]]
+                 = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 abort_fn: Optional[Callable[[int], None]] = None,
+                 bus=None):
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} outside world {world_size}")
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.config = config
+        self._clock = clock
+        self._bus = bus if bus is not None else telemetry_bus
+        self._abort_fn = abort_fn if abort_fn is not None else os._exit
+        self._on_abort = on_abort
+        self._watchdog_probe = watchdog_probe or (lambda: False)
+        if config.peers:
+            if len(config.peers) != self.world_size:
+                raise ValueError(
+                    f"tpu.cluster_health.peers has {len(config.peers)} "
+                    f"entries for a world of {self.world_size}")
+            self.peers = [_parse_peer(p) for p in config.peers]
+        else:
+            self.peers = [(config.host, int(config.port_base) + r)
+                          for r in range(self.world_size)]
+        self._schedule = SilenceSchedule(
+            self.world_size,
+            HealthConfig(suspect_after_s=config.suspect_after_s,
+                         down_after_s=config.down_after_s,
+                         recover_probes=config.recover_probes),
+            clock=clock, on_transition=self._on_transition)
+
+        self._lock = threading.Lock()
+        self._step = 0
+        self._last_step_ts: Optional[float] = None
+        self._step_time_ewma = 0.0
+        # own digests: step -> digest (bounded FIFO); peer latest digests:
+        # rank -> (digest_step, digest)
+        self._digests: Dict[int, int] = {}
+        self._peer_digests: Dict[int, Tuple[int, int]] = {}
+        self._peer_info: Dict[int, Dict[str, Any]] = {}
+        self._sdc_reported: set = set()       # digest steps already flagged
+        self._sdc_pending: Optional[Dict[str, Any]] = None
+        self._desync_active: set = set()      # ranks currently skewed
+        self._straggling = False
+        self._counters = {
+            "beats_sent": 0, "beats_received": 0, "peers_down": 0,
+            "peers_up": 0, "stragglers": 0, "desyncs": 0,
+            "sdc_mismatches": 0, "aborts": 0,
+        }
+        self._aborted = False
+        self._stop = threading.Event()
+        self._server: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        """Bind the beat server and start the daemon sender/receiver."""
+        if self._threads or self.world_size < 2:
+            return
+        host, port = self.peers[self.rank]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(2 * self.world_size)
+        srv.settimeout(0.2)  # bounded accept so stop() is honored
+        self._server = srv
+        for name, target in (("ds-tpu-health-recv", self._serve),
+                             ("ds-tpu-health-send", self._beat_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        logger.info(
+            "cluster health plane up: rank %d/%d listening on %s:%d "
+            "(beat %.2fs, suspect %.1fs, down %.1fs)", self.rank,
+            self.world_size, host, port, self.config.beat_interval_s,
+            self.config.suspect_after_s, self.config.down_after_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        srv, self._server = self._server, None
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self._threads = []
+
+    # ------------------------------------------------------------------
+    # daemon loops (never on the main thread; never through collectives)
+    # ------------------------------------------------------------------
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            srv = self._server
+            if srv is None:
+                return
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # closed by stop()
+            try:
+                conn.settimeout(1.0)
+                chunks = []
+                while True:
+                    data = conn.recv(4096)
+                    if not data:
+                        break
+                    chunks.append(data)
+                if chunks:
+                    self._on_beat(json.loads(b"".join(chunks).decode()))
+            except (OSError, ValueError, KeyError):
+                pass  # malformed/raced beat: silence is what kills a peer
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _beat_loop(self) -> None:
+        interval = float(self.config.beat_interval_s)
+        while not self._stop.wait(interval):
+            self.send_beats()
+            self.poll_once()
+
+    # ------------------------------------------------------------------
+    # beats
+    # ------------------------------------------------------------------
+    def _build_beat(self) -> Dict[str, Any]:
+        try:
+            armed = bool(self._watchdog_probe())
+        except Exception:
+            armed = False
+        with self._lock:
+            beat = {
+                "rank": self.rank,
+                "step": self._step,
+                "watchdog_armed": armed,
+                "step_time_ewma": self._step_time_ewma,
+            }
+            if self._digests:
+                dstep = max(self._digests)
+                beat["digest_step"] = dstep
+                beat["param_digest"] = self._digests[dstep]
+        return beat
+
+    def send_beats(self) -> None:
+        """One fan-out of the current beat to every peer (the sender
+        loop body; also the test seam). Send failures are deliberately
+        ignored: a dead peer is detected by OUR silence schedule on ITS
+        beats, not by connect errors here."""
+        payload = (json.dumps(self._build_beat()) + "\n").encode()
+        timeout = min(1.0, float(self.config.beat_interval_s))
+        for r, (host, port) in enumerate(self.peers):
+            if r == self.rank:
+                continue
+            try:
+                with socket.create_connection((host, port),
+                                              timeout=timeout) as s:
+                    s.sendall(payload)
+            except OSError:
+                pass
+        with self._lock:
+            self._counters["beats_sent"] += 1
+        # sending IS our own sign of life
+        self._schedule.heartbeat(self.rank)
+
+    def _on_beat(self, beat: Dict[str, Any]) -> None:
+        r = int(beat["rank"])
+        if r == self.rank or not 0 <= r < self.world_size:
+            return
+        with self._lock:
+            self._counters["beats_received"] += 1
+            self._peer_info[r] = {
+                "step": int(beat.get("step", 0)),
+                "watchdog_armed": bool(beat.get("watchdog_armed", False)),
+                "step_time_ewma": float(beat.get("step_time_ewma", 0.0)),
+            }
+            own_step = self._step
+        self._schedule.heartbeat(r)
+        self._check_desync(r, int(beat.get("step", 0)), own_step)
+        if beat.get("param_digest") is not None:
+            self._note_peer_digest(r, int(beat["digest_step"]),
+                                   int(beat["param_digest"]))
+
+    # ------------------------------------------------------------------
+    # engine-facing surface (main thread)
+    # ------------------------------------------------------------------
+    def notify_step(self, step: int) -> None:
+        """Step-boundary hook: advances the step the beats report and
+        folds the inter-step wall time into the straggler EWMA."""
+        now = self._clock()
+        alpha = float(self.config.ewma_alpha)
+        with self._lock:
+            self._step = int(step)
+            if self._last_step_ts is not None:
+                dt = max(now - self._last_step_ts, 0.0)
+                self._step_time_ewma = (
+                    dt if self._step_time_ewma == 0.0 else
+                    alpha * dt + (1.0 - alpha) * self._step_time_ewma)
+            self._last_step_ts = now
+
+    def submit_digest(self, step: int, digest: Optional[int]) -> None:
+        """Record our param digest for ``step`` (rides on the next beat)
+        and cross-check it against any peer digest already received for
+        the same step."""
+        if digest is None:
+            return
+        with self._lock:
+            self._digests[int(step)] = int(digest)
+            while len(self._digests) > _DIGEST_HISTORY:
+                del self._digests[min(self._digests)]
+            peer_view = dict(self._peer_digests)
+        for r, (dstep, d) in peer_view.items():
+            if dstep == int(step):
+                self._compare_digest(r, dstep, d)
+
+    def take_sdc_fault(self) -> Optional[Dict[str, Any]]:
+        """Pop the pending SDC mismatch (``sdc_action: rollback`` path):
+        the engine polls this at the step boundary and routes a non-None
+        result through the sentinel's rollback."""
+        with self._lock:
+            fault, self._sdc_pending = self._sdc_pending, None
+        return fault
+
+    def counters(self) -> Dict[str, int]:
+        """Cumulative ``Health/*`` counters for the monitor export."""
+        with self._lock:
+            return dict(self._counters)
+
+    def peer_states(self) -> Dict[int, str]:
+        return self._schedule.states()
+
+    def peer_info(self) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            return {r: dict(v) for r, v in self._peer_info.items()}
+
+    # ------------------------------------------------------------------
+    # detection
+    # ------------------------------------------------------------------
+    def poll_once(self) -> None:
+        """One sweep of the silence schedule + straggler evaluation (the
+        sender loop body; also the test seam)."""
+        self._schedule.sweep()
+        self._check_straggler()
+
+    def _on_transition(self, i: int, frm: str, to: str, reason: str,
+                       probes: int) -> None:
+        if i == self.rank:
+            return
+        if to == DOWN:
+            with self._lock:
+                self._counters["peers_down"] += 1
+                step = self._step
+            # NB: the hook runs under the schedule's (non-reentrant)
+            # lock — no schedule calls from here; ``reason`` already
+            # carries the silence duration
+            self._bus.publish(
+                KIND_HEALTH_PEER_DOWN, step=step, severity="warning",
+                peer=i, previous=frm, reason=reason)
+            logger.error(
+                "cluster health: peer %d is DOWN (%s) at step %d",
+                i, reason, step)
+            if self.config.abort_on_peer_loss:
+                self._coordinated_abort(
+                    "peer_loss", peer=i, cause=reason, step=step)
+        elif to == HEALTHY and frm in (RECOVERING, DOWN):
+            with self._lock:
+                self._counters["peers_up"] += 1
+            self._bus.publish(KIND_HEALTH_PEER_UP, peer=i, probes=probes)
+
+    def _check_desync(self, r: int, peer_step: int, own_step: int) -> None:
+        thr = int(self.config.step_skew_threshold)
+        if thr <= 0:
+            return
+        skewed = abs(peer_step - own_step) > thr
+        with self._lock:
+            was = r in self._desync_active
+            if skewed and not was:
+                self._desync_active.add(r)
+                self._counters["desyncs"] += 1
+            elif not skewed and was:
+                self._desync_active.discard(r)
+        if skewed and not was:  # edge-only, like serve.replica_down
+            self._bus.publish(
+                KIND_HEALTH_DESYNC, step=own_step, severity="warning",
+                peer=r, peer_step=peer_step, skew=peer_step - own_step)
+
+    def _check_straggler(self) -> None:
+        ratio = float(self.config.straggler_ratio)
+        if ratio <= 0:
+            return
+        with self._lock:
+            own = self._step_time_ewma
+            ewmas = [own] if own > 0 else []
+            ewmas += [v["step_time_ewma"] for v in self._peer_info.values()
+                      if v["step_time_ewma"] > 0]
+            step = self._step
+        if own <= 0 or len(ewmas) < int(self.config.straggler_min_peers):
+            return
+        median = statistics.median(ewmas)
+        lagging = median > 0 and own > ratio * median
+        with self._lock:
+            was, self._straggling = self._straggling, lagging
+            if lagging and not was:
+                self._counters["stragglers"] += 1
+        if lagging and not was:  # edge-only self-report: each host judges
+            self._bus.publish(  # its OWN skew, so the fleet gets one
+                KIND_HEALTH_STRAGGLER, step=step,  # event per straggler
+                severity="warning", own_ewma_s=round(own, 4),
+                fleet_median_s=round(median, 4),
+                ratio=round(own / median, 3))
+            logger.warning(
+                "cluster health: this host is a straggler (step ewma "
+                "%.3fs vs fleet median %.3fs)", own, median)
+
+    # ------------------------------------------------------------------
+    # SDC digest cross-check
+    # ------------------------------------------------------------------
+    def _note_peer_digest(self, r: int, dstep: int, digest: int) -> None:
+        with self._lock:
+            self._peer_digests[r] = (dstep, digest)
+            ours = self._digests.get(dstep)
+        if ours is not None:
+            self._compare_digest(r, dstep, digest)
+
+    def _compare_digest(self, r: int, dstep: int, theirs: int) -> None:
+        with self._lock:
+            ours = self._digests.get(dstep)
+            if ours is None or ours == theirs:
+                return
+            if dstep in self._sdc_reported:  # one verdict per probe step
+                return
+            self._sdc_reported.add(dstep)
+            self._counters["sdc_mismatches"] += 1
+            detail = {"peer": r, "digest_step": dstep, "ours": ours,
+                      "theirs": theirs}
+        self._bus.publish(KIND_HEALTH_SDC, step=dstep, severity="fatal",
+                          **detail)
+        logger.error(
+            "cluster health: SDC digest mismatch at step %d vs peer %d "
+            "(ours=%#010x theirs=%#010x) — a replicated parameter is no "
+            "longer bit-identical across hosts", dstep, r, ours, theirs)
+        if self.config.sdc_action == "abort":
+            self._coordinated_abort("sdc", **detail)
+        else:
+            with self._lock:
+                self._sdc_pending = dict(detail, kind="sdc")
+
+    # ------------------------------------------------------------------
+    # coordinated abort
+    # ------------------------------------------------------------------
+    def abort(self, reason: str, **detail) -> None:
+        """Public escalation hook (the engine uses it when an SDC
+        rollback has no target); same once-only coordinated abort the
+        silence schedule triggers."""
+        self._coordinated_abort(reason, **detail)
+
+    def _coordinated_abort(self, reason: str, **detail) -> None:
+        """Every survivor runs this within the silence budget of the
+        same peer event, so the per-host elastic agents see ONE
+        world-level failure (exit code 15 from every process) instead of
+        N staggered hang timeouts. ``os._exit``, like the watchdog: the
+        main thread may be unrecoverably parked in a collective."""
+        with self._lock:
+            if self._aborted:
+                return
+            self._aborted = True
+            self._counters["aborts"] += 1
+        code = int(self.config.exit_code)
+        self._bus.publish(KIND_HEALTH_ABORT, severity="fatal",
+                          reason=reason, exit_code=code, **detail)
+        logger.error(
+            "cluster health: coordinated abort (%s) — exiting with code "
+            "%d so the elastic agent relaunches the world together "
+            "(detail: %s)", reason, code, detail)
+        if self._on_abort is not None:
+            try:
+                self._on_abort(reason, dict(detail))
+            except Exception:  # forensics must not block the abort
+                logger.exception("cluster health on_abort callback failed")
+        self._abort_fn(code)
+
+
+# ---------------------------------------------------------------------------
+# SDC parameter digest (the only jax-touching code in this module; kept
+# lazy so supervisors import the plane jax-free)
+# ---------------------------------------------------------------------------
+def _bitcast_digest_fn(dtype):
+    """Jitted per-device digest: bitcast to the same-width uint, widen to
+    uint32, wrapping sum. A plain sum is permutation-invariant but ANY
+    single bit flip changes it (short of an exact 2^32 collision), which
+    is the failure model — and it is cheap enough to run every K steps."""
+    import jax
+    import jax.numpy as jnp
+
+    width = dtype.itemsize * 8
+    uint = {8: jnp.uint8, 16: jnp.uint16, 32: jnp.uint32,
+            64: jnp.uint64}[width]
+
+    def digest(x):
+        bits = jax.lax.bitcast_convert_type(x, uint)
+        return jnp.sum(bits.astype(jnp.uint32) if width != 64 else
+                       (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+
+    return jax.jit(digest)
+
+
+_DIGEST_FNS: Dict[Any, Any] = {}
+
+
+def param_digest(params) -> Optional[int]:
+    """Digest the locally-addressable bits of every **fully replicated**
+    float param leaf, mod 2**32. Returns None when no leaf qualifies
+    (e.g. every leaf ZeRO-sharded). Only feed this from engines whose
+    replication spans processes: the pipeline engine deliberately does
+    NOT, because each stage's params replicate over that stage's own
+    sub-mesh and digests from different stage owners would trivially
+    differ.
+
+    Per-process by construction: each process sums over its OWN devices'
+    shards — no collective. Replication means every process must compute
+    the same value, so a cross-mesh mismatch is bit-level divergence
+    (SDC) on some host. A ``psum`` here would average the evidence away:
+    every host would agree on the corrupted total.
+    """
+    import jax
+    import numpy as np
+
+    total = 0
+    found = False
+    for leaf in jax.tree.leaves(params):
+        if not isinstance(leaf, jax.Array):
+            continue
+        if not np.issubdtype(leaf.dtype, np.floating) and \
+                leaf.dtype.name != "bfloat16":
+            continue
+        try:
+            if not leaf.sharding.is_fully_replicated:
+                continue
+        except (AttributeError, ValueError):
+            continue
+        found = True
+        fn = _DIGEST_FNS.get(leaf.dtype)
+        if fn is None:
+            fn = _DIGEST_FNS[leaf.dtype] = _bitcast_digest_fn(leaf.dtype)
+        for shard in leaf.addressable_shards:
+            # every local copy is digested, so a flip on any ONE device
+            # shows up even before it skews training
+            total = (total + int(fn(shard.data))) % (1 << 32)
+    return total if found else None
+
+
+def build_plane(config, rank: Optional[int] = None,
+                world_size: Optional[int] = None, **kwargs
+                ) -> Optional[ClusterHealthPlane]:
+    """Engine helper: resolve ``tpu.cluster_health`` auto-enablement
+    against the live process count and return a started-able plane, or
+    None when the plane should stay off (single process, disabled)."""
+    import jax
+
+    rank = jax.process_index() if rank is None else int(rank)
+    world_size = (jax.process_count() if world_size is None
+                  else int(world_size))
+    if not config.resolve_enabled(world_size):
+        return None
+    return ClusterHealthPlane(rank, world_size, config, **kwargs)

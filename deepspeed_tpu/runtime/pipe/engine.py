@@ -230,6 +230,23 @@ class PipelineEngine:
         # compile-cache hits AFTER the envelope closes (Mem/* export)
         self._mem_programs: Dict[str, Tuple[Any, tuple]] = {}
 
+        # cluster health plane (docs/recovery.md "Cluster health & SDC
+        # defense"): out-of-band liveness + straggler beats — exactly the
+        # engine where they matter most, since a stalled peer parks every
+        # other process inside a ppermute until the plane (not N local
+        # watchdogs) pulls the plug. The pipe engine feeds steps only,
+        # not param digests: each stage's params replicate over that
+        # stage's own sub-mesh, so digests are not comparable between
+        # stage-owning processes.
+        self.health_plane = None
+        ch_cfg = config.tpu.cluster_health_config
+        if ch_cfg.resolve_enabled(jax.process_count()):
+            from deepspeed_tpu.runtime.health import ClusterHealthPlane
+
+            self.health_plane = ClusterHealthPlane(
+                jax.process_index(), jax.process_count(), ch_cfg)
+            self.health_plane.start()
+
         log_dist(
             f"PipelineEngine: stages={self.num_stages}, "
             f"bounds={bounds}, micro_batches={self.micro_batches}, "
@@ -586,6 +603,8 @@ class PipelineEngine:
         self.global_steps += 1
         self.micro_steps += M
         self.global_samples += self.train_batch_size
+        if self.health_plane is not None:
+            self.health_plane.notify_step(self.global_steps)
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self.tput_timer.stop(global_step=True)

@@ -264,6 +264,14 @@ class HangWatchdog:
         with self._lock:
             self._deadline = None
 
+    @property
+    def armed(self) -> bool:
+        """True while a step is in flight (between arm and disarm) — the
+        cluster health plane samples this into every beat so survivors
+        of a peer loss can report WHERE they were stuck."""
+        with self._lock:
+            return self._deadline is not None
+
     def stop(self):
         self._stop.set()
         self.disarm()

@@ -68,13 +68,19 @@ from deepspeed_tpu.telemetry.bus import (
     KIND_SERVE_REPLICA_UP,
     telemetry_bus,
 )
-
-# Replica health states (the full cycle: healthy -> suspect -> down ->
-# recovering -> healthy; heartbeats move left, silence moves right)
-HEALTHY = "healthy"
-SUSPECT = "suspect"
-DOWN = "down"
-RECOVERING = "recovering"
+# The silence-schedule state machine grew up here and moved to
+# utils/health_state.py when the training cluster health plane
+# (runtime/health.py) needed the same healthy→suspect→down→recovering
+# tracking for peer processes; re-exported so existing importers keep
+# working (``from deepspeed_tpu.serving.fleet import HEALTHY, ...``).
+from deepspeed_tpu.utils.health_state import (  # noqa: F401  (re-export)
+    DOWN,
+    HEALTHY,
+    RECOVERING,
+    SUSPECT,
+    HealthConfig,
+    SilenceSchedule,
+)
 
 
 class ReplicaDead(RuntimeError):
@@ -86,22 +92,6 @@ class ReplicaDead(RuntimeError):
         self.replica = int(replica)
 
 
-@dataclass
-class HealthConfig:
-    suspect_after_s: float = 2.0   # silence before healthy -> suspect
-    down_after_s: float = 6.0      # silence before (any live) -> down
-    recover_probes: int = 2        # heartbeats to go recovering -> healthy
-
-    def __post_init__(self):
-        if not 0 < self.suspect_after_s < self.down_after_s:
-            raise ValueError(
-                "need 0 < suspect_after_s < down_after_s, got "
-                f"{self.suspect_after_s} / {self.down_after_s}")
-        if self.recover_probes < 1:
-            raise ValueError(
-                f"recover_probes must be >= 1, got {self.recover_probes}")
-
-
 class FleetHealth:
     """Heartbeat-driven replica health; see module docstring.
 
@@ -110,6 +100,12 @@ class FleetHealth:
     ``mark_down(i)`` when the transport says so (EOF beats any timer).
     Thread-safe: the demo pumps replica pipes from one thread, but
     signal handlers and tests poke it from others.
+
+    A thin serving skin over :class:`SilenceSchedule`: the state machine
+    lives in ``utils/health_state.py``; this class owns only the
+    edge-triggered ``serve.replica_down`` / ``serve.replica_up``
+    telemetry (published from the schedule's transition hook, i.e. at
+    exactly the point the pre-extraction ``_set`` published).
     """
 
     def __init__(self, n_replicas: int, config: Optional[HealthConfig] = None,
@@ -117,90 +113,57 @@ class FleetHealth:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.n_replicas = int(n_replicas)
-        self.config = config or HealthConfig()
-        self._clock = clock
         self._bus = bus if bus is not None else telemetry_bus
-        self._lock = threading.Lock()
-        now = self._clock()
-        self._state = [HEALTHY] * self.n_replicas
-        self._last_beat = [now] * self.n_replicas
-        self._probes = [0] * self.n_replicas
+        self._schedule = SilenceSchedule(
+            self.n_replicas, config=config, clock=clock,
+            on_transition=self._on_transition)
+
+    @property
+    def config(self) -> HealthConfig:
+        return self._schedule.config
+
+    @property
+    def transitions(self) -> List[Tuple[float, int, str, str]]:
         # (ts, replica, from, to) — bounded by the number of real
         # transitions, which is tiny; tests and the demo read it
-        self.transitions: List[Tuple[float, int, str, str]] = []
+        return self._schedule.transitions
 
-    def _set(self, i: int, to: str, reason: str) -> None:
-        """Caller holds the lock. Publishes only on the down/up edges."""
-        frm = self._state[i]
-        if frm == to:
-            return
-        self._state[i] = to
-        self.transitions.append((self._clock(), i, frm, to))
+    def _on_transition(self, i: int, frm: str, to: str, reason: str,
+                       probes: int) -> None:
+        """Publishes only on the down/up edges."""
         if to == DOWN:
             self._bus.publish(KIND_SERVE_REPLICA_DOWN, severity="warning",
                               replica=i, previous=frm, reason=reason)
         elif to == HEALTHY and frm in (RECOVERING, DOWN):
             self._bus.publish(KIND_SERVE_REPLICA_UP, replica=i,
-                              probes=self._probes[i])
+                              probes=probes)
 
     def heartbeat(self, i: int) -> str:
         """Replica ``i`` showed a sign of life; returns its new state."""
-        with self._lock:
-            self._last_beat[i] = self._clock()
-            st = self._state[i]
-            if st == DOWN:
-                self._probes[i] = 1
-                if self.config.recover_probes <= 1:
-                    self._set(i, HEALTHY, "recovered")
-                else:
-                    self._set(i, RECOVERING, "heartbeat")
-            elif st == RECOVERING:
-                self._probes[i] += 1
-                if self._probes[i] >= self.config.recover_probes:
-                    self._set(i, HEALTHY, "recovered")
-            elif st == SUSPECT:
-                self._set(i, HEALTHY, "heartbeat")
-            return self._state[i]
+        return self._schedule.heartbeat(i)
 
     def sweep(self) -> None:
         """Apply the silence schedule to every replica."""
-        with self._lock:
-            now = self._clock()
-            for i in range(self.n_replicas):
-                st = self._state[i]
-                if st == DOWN:
-                    continue
-                silence = now - self._last_beat[i]
-                if silence >= self.config.down_after_s:
-                    self._probes[i] = 0
-                    self._set(i, DOWN, f"silent {silence:.1f}s")
-                elif st == HEALTHY and \
-                        silence >= self.config.suspect_after_s:
-                    self._set(i, SUSPECT, "silence")
+        self._schedule.sweep()
 
     def mark_down(self, i: int, reason: str = "reported") -> None:
         """Unambiguous death (pipe EOF, waitpid): skip the timers."""
-        with self._lock:
-            self._probes[i] = 0
-            self._set(i, DOWN, reason)
+        self._schedule.mark_down(i, reason)
 
     def state(self, i: int) -> str:
-        with self._lock:
-            return self._state[i]
+        return self._schedule.state(i)
 
     def states(self) -> Dict[int, str]:
-        with self._lock:
-            return {i: s for i, s in enumerate(self._state)}
+        return self._schedule.states()
 
     def live(self) -> List[bool]:
         """The routing mask: everything except ``down`` is routable —
         ``suspect`` keeps its traffic (it may just be slow) and
         ``recovering`` gets its homes back (re-affinity)."""
-        with self._lock:
-            return [s != DOWN for s in self._state]
+        return self._schedule.live()
 
     def n_live(self) -> int:
-        return sum(self.live())
+        return self._schedule.n_live()
 
 
 # ---------------------------------------------------------------------

@@ -57,6 +57,15 @@ KIND_SERVE_KV_TRANSFER = "serve.kv_transfer"
 KIND_SERVE_SPEC_ACCEPT = "serve.spec_accept"
 KIND_SHUTDOWN = "shutdown.graceful"
 KIND_ELASTIC_RESHARD = "elastic.reshard"
+# cluster health plane (runtime/health.py): peer liveness over the
+# out-of-band heartbeat mesh, step-time straggler detection, step-skew
+# desync, and SDC parameter-digest mismatches
+KIND_HEALTH_PEER_DOWN = "health.peer_down"
+KIND_HEALTH_PEER_UP = "health.peer_up"
+KIND_HEALTH_STRAGGLER = "health.straggler"
+KIND_HEALTH_DESYNC = "health.desync"
+KIND_HEALTH_SDC = "health.sdc"
+KIND_HEALTH_ABORT = "health.abort"
 
 
 def _default_rank() -> int:

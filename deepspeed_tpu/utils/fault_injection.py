@@ -18,7 +18,11 @@ failures reproducible in unit tests:
   corrupt or stall an engine's input batches from a chosen step, the
   training-health faults (NaN loss, loss spike, wedged step) that drive
   the sentinel's detect→skip→rollback→diverge path end-to-end
-  (docs/recovery.md "Divergence and hang recovery").
+  (docs/recovery.md "Divergence and hang recovery");
+* :func:`stall_at_step` / :func:`bitflip_at_step` — whole-process wedge
+  (SIGSTOP) and silent parameter corruption, the cluster-scale faults
+  only the cross-host health plane can catch (docs/recovery.md
+  "Cluster health & SDC defense").
 
 Everything here is process-global monkeypatching of ``builtins.open`` /
 ``os.replace`` — test-only machinery, deliberately free of jax imports so
@@ -253,4 +257,86 @@ def hang_at_step(engine, step: int, seconds: float,
         return batch
 
     with _batch_fault(engine, step, times, stall) as injector:
+        yield injector
+
+
+@contextmanager
+def stall_at_step(engine, step: int, sleep_s: Optional[float] = None,
+                  times: Optional[int] = 1):
+    """Wedge THIS WHOLE PROCESS at global step ``step`` — the cluster
+    health plane's target fault (docs/recovery.md "Cluster health & SDC
+    defense"), as opposed to :func:`hang_at_step` which stalls only the
+    batch path and leaves daemon threads (and the process) responsive.
+
+    ``sleep_s=None`` delivers ``SIGSTOP`` to the process itself: every
+    thread — including the health plane's heartbeat sender — freezes,
+    which is what a kernel-level wedge or a stopped VM looks like to
+    peers, and only SIGCONT/SIGKILL from outside can end it. A float
+    ``sleep_s`` sleeps inside batch dispatch instead (a bounded stall
+    the process recovers from by itself; useful where SIGSTOP would
+    wedge the TEST harness too)."""
+    def wedge(batch):
+        if sleep_s is None:
+            os.kill(os.getpid(), signal_module.SIGSTOP)
+        else:
+            time.sleep(sleep_s)
+        return batch
+
+    with _batch_fault(engine, step, times, wedge) as injector:
+        yield injector
+
+
+@contextmanager
+def bitflip_at_step(engine, step: int, leaf: Optional[str] = None,
+                    bit: int = 1, times: Optional[int] = 1):
+    """Flip one mantissa bit of one element in a parameter leaf of
+    ``engine._params`` at global step ``step`` — a silent data
+    corruption (SDC): the run keeps training on a wrong weight with no
+    NaN, no crash, nothing for the sentinel to see. Only the health
+    plane's cross-host parameter-digest probe can catch it, which is
+    exactly what this injector exists to prove.
+
+    ``leaf`` selects the target by path substring (e.g. ``"dense/w"``);
+    None takes the first floating-point leaf. ``bit`` is the bit index
+    to XOR in element 0 — low mantissa bits make the corruption
+    numerically tiny, maximally silent. The flip is applied to every
+    addressable shard of the leaf so a replicated array stays
+    self-consistent WITHIN the process (the digest divergence is
+    between processes: only this one flips).
+
+    Unlike the batch faults above this imports jax; keep it out of
+    agent-side tests."""
+    import jax
+    import numpy as np
+
+    def flip(batch):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(engine._params)
+        target = None
+        for path, arr in flat:
+            if not hasattr(arr, "dtype") or not (
+                    np.issubdtype(arr.dtype, np.floating)
+                    or arr.dtype.name == "bfloat16"):
+                continue
+            name = jax.tree_util.keystr(path)
+            if leaf is None or leaf in name:
+                target = (path, name, arr)
+                break
+        if target is None:
+            raise ValueError(f"bitflip_at_step: no float leaf matching "
+                             f"{leaf!r} in engine._params")
+        path, name, arr = target
+        uint_dtype = np.dtype(f"uint{arr.dtype.itemsize * 8}")
+        bufs = []
+        for sh in arr.addressable_shards:
+            data = np.array(sh.data)  # owned, writable copy
+            view = data.reshape(-1).view(uint_dtype)
+            view[0] ^= np.asarray(1 << bit, dtype=uint_dtype)
+            bufs.append(jax.device_put(data, sh.device))
+        flipped = jax.make_array_from_single_device_arrays(
+            arr.shape, arr.sharding, bufs)
+        leaves = [flipped if p is path else a for p, a in flat]
+        engine._params = jax.tree_util.tree_unflatten(treedef, leaves)
+        return batch
+
+    with _batch_fault(engine, step, times, flip) as injector:
         yield injector

@@ -1,0 +1,563 @@
+"""Cluster health plane unit tests (docs/recovery.md "Cluster health &
+SDC defense"): config resolution, the consolidated exit-code contract,
+the silence→coordinated-abort seam on a fake clock, a real loopback TCP
+beat mesh, straggler/desync detection, SDC digest cross-checks with both
+``sdc_action`` policies, the :func:`param_digest` probe itself, the new
+whole-process fault injectors, and the world-scoped elastic agent that
+turns N coordinated exit-15s into exactly ONE relaunch.
+"""
+
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    DSWorldAgent)
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config import ClusterHealthConfig
+from deepspeed_tpu.runtime.health import ClusterHealthPlane, param_digest
+from deepspeed_tpu.runtime.sentinel import DivergenceError, HangWatchdog
+from deepspeed_tpu.telemetry.bus import (KIND_HEALTH_ABORT,
+                                         KIND_HEALTH_DESYNC,
+                                         KIND_HEALTH_PEER_DOWN,
+                                         KIND_HEALTH_PEER_UP,
+                                         KIND_HEALTH_SDC,
+                                         KIND_HEALTH_STRAGGLER,
+                                         TelemetryBus)
+from deepspeed_tpu.utils import fault_injection as fi
+from deepspeed_tpu.utils.health_state import DOWN, HEALTHY
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _cfg(**kw):
+    base = dict(beat_interval_s=0.05, suspect_after_s=0.5, down_after_s=1.5,
+                straggler_min_peers=2)
+    base.update(kw)
+    return ClusterHealthConfig(**base)
+
+
+def _plane(rank=0, world=2, cfg=None, clock=None, **kw):
+    """A plane with every side effect captured: fake abort, private bus,
+    fake clock. Never started — beats are injected via _on_beat and time
+    is driven through poll_once, exactly the HangWatchdog test seams."""
+    clock = clock or _Clock()
+    bus = TelemetryBus()
+    evs = []
+    bus.subscribe(evs.append)
+    aborts = []
+    p = ClusterHealthPlane(rank, world, cfg or _cfg(), clock=clock,
+                           abort_fn=aborts.append, bus=bus, **kw)
+    return p, clock, evs, aborts
+
+
+def _beat(rank, step=0, ewma=0.0, **kw):
+    beat = {"rank": rank, "step": step, "watchdog_armed": False,
+            "step_time_ewma": ewma}
+    beat.update(kw)
+    return beat
+
+
+# ---------------------------------------------------------------------------
+# config + exit-code contract
+# ---------------------------------------------------------------------------
+class TestClusterHealthConfig:
+    def test_auto_enables_only_multiprocess(self):
+        cfg = _cfg()
+        assert cfg.enabled == "auto"
+        assert not cfg.resolve_enabled(1)
+        assert cfg.resolve_enabled(2)
+        assert ClusterHealthConfig(enabled=True).resolve_enabled(1)
+        assert not ClusterHealthConfig(enabled=False).resolve_enabled(4)
+
+    def test_validation(self):
+        # validation is a from_dict concern (the ConfigModel pattern:
+        # the constructor trusts programmatic callers, JSON is checked)
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+        for bad in (dict(enabled="yes"),
+                    dict(beat_interval_s=0.0),
+                    dict(suspect_after_s=3.0, down_after_s=2.0),
+                    dict(beat_interval_s=2.0, suspect_after_s=1.0,
+                         down_after_s=3.0),
+                    dict(recover_probes=0),
+                    dict(sdc_action="panic"),
+                    dict(ewma_alpha=0.0),
+                    dict(exit_code=0),
+                    dict(digest_every_k=-1)):
+            with pytest.raises(DeepSpeedConfigError):
+                ClusterHealthConfig.from_dict(bad)
+
+    def test_tpu_block_wiring(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 8,
+            "tpu": {"cluster_health": {"digest_every_k": 16,
+                                       "sdc_action": "rollback"}}})
+        ch = cfg.tpu.cluster_health_config
+        assert ch.digest_every_k == 16 and ch.sdc_action == "rollback"
+        assert ch.exit_code == C.PEER_LOSS_EXIT_CODE_DEFAULT
+
+    def test_peer_list_must_match_world(self):
+        with pytest.raises(ValueError, match="2 entries"):
+            ClusterHealthPlane(0, 3, _cfg(peers=["a:1", "b:2"]))
+
+
+class TestExitCodeContract:
+    def test_meanings_table_covers_all_codes(self):
+        assert set(C.EXIT_CODE_MEANINGS) == {
+            C.DIVERGENCE_EXIT_CODE_DEFAULT,
+            C.SENTINEL_HANG_EXIT_CODE_DEFAULT,
+            C.PEER_LOSS_EXIT_CODE_DEFAULT}
+        assert len({C.DIVERGENCE_EXIT_CODE_DEFAULT,
+                    C.SENTINEL_HANG_EXIT_CODE_DEFAULT,
+                    C.PEER_LOSS_EXIT_CODE_DEFAULT}) == 3
+
+    def test_restartability_flags(self):
+        # divergence replays on restart (terminal); hang and peer-loss
+        # are exactly what a relaunch fixes
+        assert not C.EXIT_CODE_MEANINGS[C.DIVERGENCE_EXIT_CODE_DEFAULT][1]
+        assert C.EXIT_CODE_MEANINGS[C.SENTINEL_HANG_EXIT_CODE_DEFAULT][1]
+        assert C.EXIT_CODE_MEANINGS[C.PEER_LOSS_EXIT_CODE_DEFAULT][1]
+
+    def test_consumers_import_the_constants(self):
+        assert DivergenceError("x").exit_code == \
+            C.DIVERGENCE_EXIT_CODE_DEFAULT
+        assert HangWatchdog(1.0).exit_code == \
+            C.SENTINEL_HANG_EXIT_CODE_DEFAULT
+        assert ClusterHealthConfig().exit_code == \
+            C.PEER_LOSS_EXIT_CODE_DEFAULT
+        agent = DSElasticAgent(["true"], {})
+        assert agent.divergence_exit_codes == \
+            {C.DIVERGENCE_EXIT_CODE_DEFAULT}
+
+    def test_watchdog_armed_property(self):
+        wd = HangWatchdog(30.0)
+        assert not wd.armed
+        wd.arm()
+        assert wd.armed
+        wd.disarm()
+        assert not wd.armed
+
+
+# ---------------------------------------------------------------------------
+# silence -> peer_down -> coordinated abort (fake clock, no sockets)
+# ---------------------------------------------------------------------------
+class TestSilenceToAbort:
+    def test_peer_silence_aborts_with_15(self):
+        dumps = []
+        p, clock, evs, aborts = _plane(
+            on_abort=lambda reason, detail: dumps.append((reason, detail)))
+        p._on_beat(_beat(1, step=3))
+        p.notify_step(4)
+        clock.t = 2.0  # past down_after_s=1.5 of peer silence
+        p.poll_once()
+        assert p.peer_states()[1] == DOWN
+        kinds = [e["kind"] for e in evs]
+        assert kinds == [KIND_HEALTH_PEER_DOWN, KIND_HEALTH_ABORT]
+        assert evs[0]["peer"] == 1 and evs[0]["step"] == 4
+        assert aborts == [C.PEER_LOSS_EXIT_CODE_DEFAULT]
+        assert dumps and dumps[0][0] == "peer_loss"
+        assert p.counters()["peers_down"] == 1
+        assert p.counters()["aborts"] == 1
+
+    def test_abort_fires_once(self):
+        p, clock, evs, aborts = _plane(world=3)
+        p._on_beat(_beat(1))
+        p._on_beat(_beat(2))
+        clock.t = 2.0
+        p.poll_once()  # both peers go down in one sweep
+        assert aborts == [C.PEER_LOSS_EXIT_CODE_DEFAULT]
+        p.abort("manual")
+        assert len(aborts) == 1
+
+    def test_abort_on_peer_loss_false_observes_only(self):
+        p, clock, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False))
+        p._on_beat(_beat(1))
+        clock.t = 2.0
+        p.poll_once()
+        assert p.peer_states()[1] == DOWN
+        assert [e["kind"] for e in evs] == [KIND_HEALTH_PEER_DOWN]
+        assert aborts == []
+
+    def test_recovered_peer_publishes_up_edge(self):
+        p, clock, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False,
+                                                recover_probes=1))
+        p._on_beat(_beat(1))
+        clock.t = 2.0
+        p.poll_once()
+        p._on_beat(_beat(1, step=9))
+        kinds = [e["kind"] for e in evs]
+        assert kinds == [KIND_HEALTH_PEER_DOWN, KIND_HEALTH_PEER_UP]
+        assert p.peer_states()[1] == HEALTHY
+        assert p.counters()["peers_up"] == 1
+
+    def test_own_silence_never_self_aborts(self):
+        # rank 0 never beats (it only beats when send_beats runs): its own
+        # schedule entry goes down, but the transition hook skips self
+        p, clock, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False))
+        clock.t = 2.0
+        p.poll_once()
+        assert all(e.get("peer") != 0 for e in evs)
+        assert aborts == []
+
+    def test_beat_payload_carries_step_and_watchdog(self):
+        p, clock, _, _ = _plane(watchdog_probe=lambda: True)
+        p.notify_step(7)
+        beat = p._build_beat()
+        assert beat["rank"] == 0 and beat["step"] == 7
+        assert beat["watchdog_armed"] is True
+        assert "param_digest" not in beat  # none submitted yet
+        p.submit_digest(7, 0xABC)
+        beat = p._build_beat()
+        assert beat["digest_step"] == 7 and beat["param_digest"] == 0xABC
+
+
+# ---------------------------------------------------------------------------
+# real TCP mesh on loopback
+# ---------------------------------------------------------------------------
+class TestLoopbackMesh:
+    def test_two_planes_exchange_beats(self):
+        from deepspeed_tpu.elasticity.elastic_agent import _free_port
+
+        ports = [_free_port(), _free_port()]
+        peers = [f"127.0.0.1:{p}" for p in ports]
+        cfg = _cfg(peers=peers, beat_interval_s=0.05, suspect_after_s=5.0,
+                   down_after_s=10.0)
+        planes = []
+        try:
+            for r in range(2):
+                p, _, _, _ = _plane(rank=r, cfg=cfg)
+                p._clock = time.monotonic  # real transport needs real time
+                p._schedule._clock = time.monotonic
+                p.start()
+                planes.append(p)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if all(p.counters()["beats_received"] >= 2 for p in planes):
+                    break
+                time.sleep(0.02)
+            for p in planes:
+                assert p.counters()["beats_received"] >= 2
+                assert p.counters()["beats_sent"] >= 2
+            assert planes[0].peer_states()[1] == HEALTHY
+            assert planes[1].peer_states()[0] == HEALTHY
+            assert 1 in planes[0].peer_info()
+        finally:
+            for p in planes:
+                p.stop()
+                p.stop()  # idempotent
+
+    def test_single_process_world_is_a_noop(self):
+        p, _, _, _ = _plane(rank=0, world=1, cfg=_cfg())
+        p.start()
+        assert p._threads == []
+        p.stop()
+
+
+# ---------------------------------------------------------------------------
+# straggler + desync
+# ---------------------------------------------------------------------------
+class TestStragglerAndDesync:
+    def test_straggler_edge_only_self_report(self):
+        p, clock, evs, _ = _plane(world=3, cfg=_cfg(
+            abort_on_peer_loss=False, straggler_ratio=1.5, ewma_alpha=1.0))
+        for step in range(1, 4):  # own step time: 1.0s
+            clock.t = float(step)
+            p.notify_step(step)
+        p._on_beat(_beat(1, ewma=0.2))
+        p._on_beat(_beat(2, ewma=0.3))
+        p.poll_once()
+        p.poll_once()  # still straggling: no second event
+        straggle = [e for e in evs if e["kind"] == KIND_HEALTH_STRAGGLER]
+        assert len(straggle) == 1
+        assert straggle[0]["own_ewma_s"] == pytest.approx(1.0)
+        assert straggle[0]["fleet_median_s"] == pytest.approx(0.3)
+        assert p.counters()["stragglers"] == 1
+
+    def test_straggler_clears_and_can_refire(self):
+        p, clock, evs, _ = _plane(world=3, cfg=_cfg(
+            abort_on_peer_loss=False, straggler_ratio=1.5, ewma_alpha=1.0))
+        clock.t = 1.0
+        p.notify_step(1)
+        clock.t = 2.0
+        p.notify_step(2)
+        p._on_beat(_beat(1, ewma=0.2))
+        p._on_beat(_beat(2, ewma=0.2))
+        p.poll_once()
+        # this host catches back up: ewma_alpha=1.0 tracks the last step
+        clock.t = 2.2
+        p.notify_step(3)
+        p.poll_once()
+        p._on_beat(_beat(1, ewma=0.01))
+        p._on_beat(_beat(2, ewma=0.01))
+        clock.t = 3.2
+        p.notify_step(4)
+        p.poll_once()
+        straggle = [e for e in evs if e["kind"] == KIND_HEALTH_STRAGGLER]
+        assert len(straggle) == 2
+
+    def test_too_few_samples_stays_quiet(self):
+        p, clock, evs, _ = _plane(world=3, cfg=_cfg(
+            abort_on_peer_loss=False, straggler_min_peers=3, ewma_alpha=1.0))
+        clock.t = 1.0
+        p.notify_step(1)
+        clock.t = 2.0
+        p.notify_step(2)
+        p._on_beat(_beat(1, ewma=0.1))  # only 2 samples < min_peers=3
+        p.poll_once()
+        assert [e for e in evs if e["kind"] == KIND_HEALTH_STRAGGLER] == []
+
+    def test_desync_edge_only_and_clears(self):
+        p, _, evs, _ = _plane(cfg=_cfg(abort_on_peer_loss=False,
+                                       step_skew_threshold=5))
+        p.notify_step(10)
+        p._on_beat(_beat(1, step=30))
+        p._on_beat(_beat(1, step=31))  # still skewed: no second event
+        desync = [e for e in evs if e["kind"] == KIND_HEALTH_DESYNC]
+        assert len(desync) == 1
+        assert desync[0]["peer"] == 1 and desync[0]["skew"] == 20
+        p._on_beat(_beat(1, step=12))  # back inside the threshold
+        p._on_beat(_beat(1, step=40))  # skewed again -> new edge
+        desync = [e for e in evs if e["kind"] == KIND_HEALTH_DESYNC]
+        assert len(desync) == 2
+        assert p.counters()["desyncs"] == 2
+
+
+# ---------------------------------------------------------------------------
+# SDC digest cross-check
+# ---------------------------------------------------------------------------
+class TestSDCCrossCheck:
+    def test_mismatch_aborts_by_default(self):
+        p, _, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False))
+        p.submit_digest(10, 111)
+        p._on_beat(_beat(1, digest_step=10, param_digest=222))
+        kinds = [e["kind"] for e in evs]
+        assert kinds == [KIND_HEALTH_SDC, KIND_HEALTH_ABORT]
+        sdc = evs[0]
+        assert sdc["ours"] == 111 and sdc["theirs"] == 222
+        assert sdc["digest_step"] == 10 and sdc["severity"] == "fatal"
+        assert aborts == [C.PEER_LOSS_EXIT_CODE_DEFAULT]
+        assert p.counters()["sdc_mismatches"] == 1
+
+    def test_matching_digests_stay_silent(self):
+        p, _, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False))
+        p.submit_digest(10, 111)
+        p._on_beat(_beat(1, digest_step=10, param_digest=111))
+        assert evs == [] and aborts == []
+
+    def test_late_own_digest_still_cross_checks(self):
+        # the peer's beat can land BEFORE our own probe for that step
+        p, _, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False))
+        p._on_beat(_beat(1, digest_step=10, param_digest=222))
+        assert evs == []
+        p.submit_digest(10, 111)
+        assert [e["kind"] for e in evs] == [KIND_HEALTH_SDC,
+                                            KIND_HEALTH_ABORT]
+
+    def test_one_verdict_per_probe_step(self):
+        p, _, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False,
+                                            sdc_action="rollback"))
+        p.submit_digest(10, 111)
+        p._on_beat(_beat(1, digest_step=10, param_digest=222))
+        p._on_beat(_beat(1, digest_step=10, param_digest=222))
+        assert len([e for e in evs if e["kind"] == KIND_HEALTH_SDC]) == 1
+
+    def test_rollback_action_defers_to_engine_poll(self):
+        p, _, evs, aborts = _plane(cfg=_cfg(abort_on_peer_loss=False,
+                                            sdc_action="rollback"))
+        p.submit_digest(10, 111)
+        p._on_beat(_beat(1, digest_step=10, param_digest=222))
+        assert aborts == []  # no abort: the engine owns the repair
+        fault = p.take_sdc_fault()
+        assert fault["kind"] == "sdc" and fault["digest_step"] == 10
+        assert p.take_sdc_fault() is None  # popped
+
+    def test_digest_none_is_ignored(self):
+        p, _, evs, _ = _plane(cfg=_cfg(abort_on_peer_loss=False))
+        p.submit_digest(10, None)  # all leaves sharded: nothing to check
+        assert p._build_beat().get("param_digest") is None
+
+
+# ---------------------------------------------------------------------------
+# param_digest probe (jax)
+# ---------------------------------------------------------------------------
+class TestParamDigest:
+    def test_replicated_leaves_digest_deterministically(self):
+        import jax.numpy as jnp
+
+        params = {"dense": {"w": jnp.ones((4, 4), jnp.float32),
+                            "b": jnp.zeros((4,), jnp.float32)}}
+        d1 = param_digest(params)
+        d2 = param_digest(params)
+        assert isinstance(d1, int) and 0 <= d1 < (1 << 32)
+        assert d1 == d2
+
+    def test_any_bitflip_changes_the_digest(self):
+        import jax.numpy as jnp
+
+        base = {"w": jnp.ones((8,), jnp.float32)}
+        flipped = {"w": base["w"].at[3].set(jnp.float32(1.0000001))}
+        assert param_digest(base) != param_digest(flipped)
+
+    def test_bfloat16_and_int_leaves(self):
+        import jax.numpy as jnp
+
+        assert param_digest({"w": jnp.ones((4,), jnp.bfloat16)}) is not None
+        # integer-only trees have no probe-able leaf
+        assert param_digest({"ids": jnp.arange(4)}) is None
+        assert param_digest({"x": 3.0}) is None  # python scalars skipped
+
+    def test_sharded_leaves_are_skipped(self, eight_devices):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(eight_devices, ("dp",))
+        sharded = jax.device_put(
+            jnp.ones((8, 4)), NamedSharding(mesh, PartitionSpec("dp")))
+        replicated = jax.device_put(
+            jnp.ones((4,)), NamedSharding(mesh, PartitionSpec()))
+        assert param_digest({"s": sharded}) is None
+        assert param_digest({"s": sharded, "r": replicated}) == \
+            param_digest({"r": replicated})
+
+
+# ---------------------------------------------------------------------------
+# fault injectors
+# ---------------------------------------------------------------------------
+class _StubEngine:
+    """The surface _batch_fault touches: a step counter and a batch
+    dispatch method."""
+
+    def __init__(self, params=None):
+        self.global_steps = 0
+        self._params = params
+        self.dispatched = []
+
+    def _put_batch(self, batch):
+        self.dispatched.append(batch)
+        return batch
+
+
+class TestInjectors:
+    def test_stall_at_step_sleeps_in_dispatch(self):
+        eng = _StubEngine()
+        eng.global_steps = 5
+        with fi.stall_at_step(eng, step=5, sleep_s=0.2, times=1) as inj:
+            t0 = time.monotonic()
+            eng._put_batch("b1")
+            stalled = time.monotonic() - t0
+            eng._put_batch("b2")  # times=1: second batch unharmed
+        assert inj.injected == 1
+        assert stalled >= 0.2
+        assert eng.dispatched == ["b1", "b2"]
+
+    def test_stall_at_step_waits_for_target_step(self):
+        eng = _StubEngine()
+        with fi.stall_at_step(eng, step=3, sleep_s=10.0) as inj:
+            eng._put_batch("early")  # global_steps=0 < 3: no stall
+        assert inj.injected == 0
+
+    def test_bitflip_flips_one_element_of_named_leaf(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        params = {"dense": {"w": jnp.ones((4, 4), jnp.float32)},
+                  "head": {"w": jnp.ones((2,), jnp.float32)}}
+        eng = _StubEngine(params=params)
+        before = param_digest(eng._params)
+        with fi.bitflip_at_step(eng, step=0, leaf="dense", bit=1) as inj:
+            eng._put_batch(None)
+        assert inj.injected == 1
+        after = param_digest(eng._params)
+        assert after != before
+        w = np.asarray(eng._params["dense"]["w"]).reshape(-1)
+        assert w[0] != 1.0  # element 0 flipped...
+        assert np.all(w[1:] == 1.0)  # ...and nothing else
+        # a low mantissa bit: numerically tiny, maximally silent
+        assert abs(w[0] - 1.0) < 1e-5
+        np.testing.assert_array_equal(
+            np.asarray(eng._params["head"]["w"]), np.ones((2,)))
+
+    def test_bitflip_unknown_leaf_raises(self):
+        import jax.numpy as jnp
+
+        eng = _StubEngine(params={"w": jnp.ones((2,), jnp.float32)})
+        with fi.bitflip_at_step(eng, step=0, leaf="nonexistent") as inj:
+            with pytest.raises(ValueError, match="no float leaf"):
+                eng._put_batch(None)
+        assert inj.injected == 1  # the injector fired; the target was bad
+
+
+# ---------------------------------------------------------------------------
+# world-scoped elastic agent
+# ---------------------------------------------------------------------------
+_WORLD_WORKER = textwrap.dedent("""
+    import os, sys, time
+    rank = int(os.environ["DS_TPU_PROC_ID"])
+    incarnation = int(os.environ["DS_TPU_ELASTIC_RESTART"])
+    assert "DS_TPU_COORDINATOR" in os.environ
+    if incarnation == 0:
+        if rank == 0:
+            sys.exit(15)   # coordinated-abort survivor
+        time.sleep(60)     # wedged peer: only SIGKILL ends this
+    sys.exit(0)            # relaunched world trains on
+""")
+
+
+class TestWorldAgent:
+    def _agent(self, script, world=2, **kw):
+        env = dict(os.environ)
+        env["DS_TPU_NUM_PROCS"] = str(world)
+        kw.setdefault("backoff_s", 0.0)
+        kw.setdefault("jitter", 0.0)
+        return DSWorldAgent([sys.executable, "-c", script], {},
+                            discover_world=lambda: world, env=env, **kw)
+
+    def test_clean_world_is_one_launch(self):
+        agent = self._agent("import sys; sys.exit(0)")
+        assert agent.run() == 0
+        assert agent.world_relaunches == 0
+
+    def test_exit_15_relaunches_world_exactly_once(self):
+        agent = self._agent(_WORLD_WORKER, max_restarts=3)
+        t0 = time.monotonic()
+        assert agent.run() == 0
+        # the wedged rank 1 slept 60s; SIGKILL must have cut that short
+        assert time.monotonic() - t0 < 30.0
+        assert agent.world_relaunches == 1
+        assert agent.restart_count == 1
+
+    def test_each_rank_gets_own_proc_id_and_shared_port(self):
+        ports = iter([45001, 45002])
+        agent = self._agent("import sys; sys.exit(0)")
+        agent._port_factory = lambda: next(ports)
+        seen = []
+        real_rank_env = agent._rank_env
+
+        def spy(world, rank, port):
+            env = real_rank_env(world, rank, port)
+            seen.append((rank, env["DS_TPU_PROC_ID"],
+                         env["DS_TPU_COORDINATOR"]))
+            return env
+
+        agent._rank_env = spy
+        assert agent.run() == 0
+        assert seen == [(0, "0", "127.0.0.1:45001"),
+                        (1, "1", "127.0.0.1:45001")]
+
+    def test_divergence_still_terminal_at_world_scope(self):
+        agent = self._agent("import sys; sys.exit(13)", max_restarts=5)
+        assert agent.run() == C.DIVERGENCE_EXIT_CODE_DEFAULT
+        assert agent.world_relaunches == 0

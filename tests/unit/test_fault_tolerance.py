@@ -169,6 +169,74 @@ def test_async_engine_two_failed_writes_report_both(tmp_path, monkeypatch):
     assert cm.verify_tag_dir(str(tmp_path / "tagB")) == []
 
 
+def test_async_engine_pins_inflight_tags(tmp_path, monkeypatch):
+    """Regression (ISSUE 20 satellite): keep_n retention GC must never
+    delete a tag whose async persist is still in flight. ``wait()`` POPS
+    the pending list, so a concurrent waiter leaves it empty while the
+    write still sits with the worker — ``pinned_tags()`` is the signal
+    that survives exactly that race, proven here with a writer blocked
+    on an injected event."""
+    import threading
+
+    from deepspeed_tpu.runtime import checkpoint_engine as ce
+
+    release = threading.Event()
+    entered = threading.Event()
+    real_write = ce._write_atomic
+
+    def slow_write(host_state, path):
+        entered.set()
+        assert release.wait(timeout=30)
+        return real_write(host_state, path)
+
+    monkeypatch.setattr(ce, "_write_atomic", slow_write)
+    eng = AsyncCheckpointEngine()
+    eng.save({"w": np.ones(4, np.float32)},
+             str(tmp_path / "global_step5" / "model.msgpack"))
+    assert entered.wait(timeout=30)
+
+    # the race: a concurrent wait() drains _pending mid-flight
+    waiter = threading.Thread(target=eng.wait, daemon=True)
+    waiter.start()
+    assert eng.pinned_tags() == {"global_step5"}
+
+    release.set()
+    waiter.join(timeout=30)
+    assert eng.commit("global_step5")
+    assert eng.pinned_tags() == set()
+    # sync engines persist before save() returns: nothing to pin
+    assert MsgpackCheckpointEngine().pinned_tags() == set()
+
+
+def test_retention_gc_honors_pinned_tags(eight_devices, tmp_path):
+    """Engine half of the same contract: ``_gc_checkpoints`` unions the
+    checkpoint engine's pins into the protected set."""
+    cfg = base_config(checkpoint={"keep_n": 2})
+    engine, it = make_engine(cfg)
+    tags = []
+    for i in range(2):
+        engine.train_batch(it)
+        engine.save_checkpoint(str(tmp_path))
+        tags.append(f"global_step{engine.global_steps}")
+        mpath = cm.manifest_path(str(tmp_path / tags[-1]))
+        t = 1_000_000 + i  # strictly ordered manifest mtimes
+        os.utime(mpath, (t, t))
+    # pin the oldest tag as if its async persist were still in flight
+    engine.checkpoint_engine.pinned_tags = lambda: {tags[0]}
+    for i in range(2, 4):
+        engine.train_batch(it)
+        engine.save_checkpoint(str(tmp_path))
+        tags.append(f"global_step{engine.global_steps}")
+        mpath = cm.manifest_path(str(tmp_path / tags[-1]))
+        t = 1_000_000 + i
+        os.utime(mpath, (t, t))
+
+    remaining = {d for d in os.listdir(tmp_path) if (tmp_path / d).is_dir()}
+    assert tags[0] in remaining      # pinned: survived keep_n=2
+    assert tags[1] not in remaining  # unpinned old tag collected
+    assert set(tags[-2:]) <= remaining  # newest two kept
+
+
 # ---------------------------------------------------------------------------
 # engine-level recovery
 # ---------------------------------------------------------------------------

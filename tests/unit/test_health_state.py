@@ -1,0 +1,218 @@
+"""Unit tests for the shared silence-schedule state machine
+(``utils/health_state.SilenceSchedule``) and its extraction contract:
+``serving/fleet.FleetHealth`` must keep the exact observable behavior it
+had before the state machine was pulled out — edge-only
+``serve.replica_down``/``serve.replica_up`` events and the EOF fast
+path — while ``runtime/health.ClusterHealthPlane`` reuses the same
+schedule (tests/unit/test_cluster_health.py).
+
+jax-free on the schedule side, matching the module's contract that
+supervisors can import it without a runtime.
+"""
+
+import threading
+
+import pytest
+
+from deepspeed_tpu.serving.fleet import FleetHealth
+from deepspeed_tpu.telemetry.bus import (KIND_SERVE_REPLICA_DOWN,
+                                         KIND_SERVE_REPLICA_UP,
+                                         TelemetryBus)
+from deepspeed_tpu.utils.health_state import (DOWN, HEALTHY, RECOVERING,
+                                              SUSPECT, HealthConfig,
+                                              SilenceSchedule)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _sched(n=3, hook=None, **kw):
+    clock = _Clock()
+    cfg = HealthConfig(**{**dict(suspect_after_s=1.0, down_after_s=3.0,
+                                 recover_probes=2), **kw})
+    return SilenceSchedule(n, cfg, clock=clock, on_transition=hook), clock
+
+
+class TestHealthConfig:
+    def test_rejects_inverted_schedule(self):
+        with pytest.raises(ValueError):
+            HealthConfig(suspect_after_s=5.0, down_after_s=3.0)
+        with pytest.raises(ValueError):
+            HealthConfig(suspect_after_s=0.0, down_after_s=3.0)
+
+    def test_rejects_zero_probes(self):
+        with pytest.raises(ValueError):
+            HealthConfig(recover_probes=0)
+
+
+class TestSilenceSchedule:
+    def test_silence_degrades_healthy_suspect_down(self):
+        s, clock = _sched()
+        clock.t = 1.5
+        s.sweep()
+        assert s.state(0) == SUSPECT
+        clock.t = 3.5
+        s.sweep()
+        assert s.state(0) == DOWN
+        assert s.live() == [False, False, False]
+
+    def test_heartbeat_resets_silence(self):
+        s, clock = _sched()
+        clock.t = 1.5
+        s.heartbeat(0)
+        s.sweep()
+        assert s.state(0) == HEALTHY and s.state(1) == SUSPECT
+
+    def test_recovery_needs_probes(self):
+        s, clock = _sched()
+        s.mark_down(0)
+        assert s.heartbeat(0) == RECOVERING
+        assert s.live()[0]  # recovering counts as live
+        assert s.heartbeat(0) == HEALTHY
+
+    def test_single_probe_recovery_skips_recovering(self):
+        s, clock = _sched(recover_probes=1)
+        s.mark_down(0)
+        assert s.heartbeat(0) == HEALTHY
+
+    def test_mark_down_beats_timers(self):
+        s, clock = _sched()
+        s.mark_down(2, reason="eof")
+        assert s.state(2) == DOWN
+        assert s.n_live() == 2
+
+    def test_down_needs_probes_again_after_relapse(self):
+        s, clock = _sched()
+        s.mark_down(0)
+        s.heartbeat(0)  # recovering, 1 probe banked
+        s.mark_down(0)  # relapse resets the probe count
+        assert s.heartbeat(0) == RECOVERING
+        assert s.heartbeat(0) == HEALTHY
+
+    def test_hook_fires_on_every_real_edge_only(self):
+        edges = []
+        s, clock = _sched(
+            hook=lambda i, frm, to, reason, probes: edges.append(
+                (i, frm, to, reason)))
+        clock.t = 1.5
+        s.sweep()
+        s.sweep()  # already suspect: no second edge
+        clock.t = 3.5
+        s.sweep()
+        s.mark_down(0)  # already down: no edge
+        assert [(i, frm, to) for i, frm, to, _ in edges] == (
+            [(i, HEALTHY, SUSPECT) for i in range(3)]
+            + [(i, SUSPECT, DOWN) for i in range(3)])
+        assert all("silent" in r for _, frm, _, r in edges if frm == SUSPECT)
+
+    def test_hook_receives_probe_count_on_recovery(self):
+        edges = []
+        s, clock = _sched(
+            hook=lambda i, frm, to, reason, probes: edges.append(
+                (to, probes)))
+        s.mark_down(1)
+        s.heartbeat(1)
+        s.heartbeat(1)
+        assert edges == [(DOWN, 0), (RECOVERING, 1), (HEALTHY, 2)]
+
+    def test_transitions_log_and_silence(self):
+        s, clock = _sched(n=1)
+        clock.t = 2.0
+        assert s.silence(0) == pytest.approx(2.0)
+        s.sweep()
+        assert [(i, frm, to) for _, i, frm, to in s.transitions] == [
+            (0, HEALTHY, SUSPECT)]
+
+    def test_concurrent_heartbeats_and_sweeps(self):
+        # receiver threads pump heartbeats while a supervisor sweeps;
+        # nothing may deadlock or corrupt state
+        s = SilenceSchedule(4, HealthConfig(suspect_after_s=0.001,
+                                            down_after_s=0.002))
+        stop = threading.Event()
+
+        def pump(i):
+            while not stop.is_set():
+                s.heartbeat(i)
+
+        threads = [threading.Thread(target=pump, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(200):
+            s.sweep()
+            s.states()
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert all(st in (HEALTHY, SUSPECT, DOWN, RECOVERING)
+                   for st in s.states().values())
+
+    def test_rejects_empty_membership(self):
+        with pytest.raises(ValueError):
+            SilenceSchedule(0)
+
+
+class TestFleetHealthExtractionContract:
+    """FleetHealth wraps the shared schedule: its pre-extraction
+    observable surface — edge-only replica_down/up telemetry, EOF fast
+    path, live mask — must be byte-for-byte preserved (the rest of the
+    fleet suite, tests/unit/test_serving_fleet.py, runs against the
+    same wrapper)."""
+
+    def _h(self, n=3):
+        clock = _Clock()
+        bus = TelemetryBus()
+        evs = []
+        bus.subscribe(evs.append)
+        cfg = HealthConfig(suspect_after_s=1.0, down_after_s=3.0,
+                           recover_probes=2)
+        return FleetHealth(n, cfg, clock=clock, bus=bus), clock, evs
+
+    def test_down_and_up_events_are_edge_only(self):
+        h, clock, evs = self._h()
+        clock.t = 3.5
+        h.sweep()
+        h.sweep()  # no re-publish while it stays down
+        h.heartbeat(0)
+        h.heartbeat(0)
+        kinds = [(e["kind"], e.get("replica")) for e in evs]
+        assert kinds == [(KIND_SERVE_REPLICA_DOWN, 0),
+                         (KIND_SERVE_REPLICA_DOWN, 1),
+                         (KIND_SERVE_REPLICA_DOWN, 2),
+                         (KIND_SERVE_REPLICA_UP, 0)]
+
+    def test_suspect_publishes_nothing(self):
+        h, clock, evs = self._h()
+        clock.t = 1.5
+        h.sweep()
+        assert evs == []
+        assert all(s == SUSPECT for s in h.states().values())
+
+    def test_eof_fast_path_event_payload(self):
+        h, _, evs = self._h()
+        h.mark_down(2, reason="eof")
+        assert h.state(2) == DOWN
+        (ev,) = evs
+        assert ev["kind"] == KIND_SERVE_REPLICA_DOWN
+        assert ev["replica"] == 2 and ev["reason"] == "eof"
+        assert ev["previous"] == HEALTHY
+
+    def test_up_event_reports_probes(self):
+        h, _, evs = self._h()
+        h.mark_down(1)
+        h.heartbeat(1)
+        h.heartbeat(1)
+        up = [e for e in evs if e["kind"] == KIND_SERVE_REPLICA_UP]
+        assert up and up[0]["replica"] == 1 and up[0]["probes"] == 2
+
+    def test_transitions_property_delegates(self):
+        h, clock, _ = self._h()
+        h.mark_down(0)
+        assert [(i, frm, to) for _, i, frm, to in h.transitions] == [
+            (0, HEALTHY, DOWN)]
+        assert h.config.recover_probes == 2
